@@ -1,0 +1,24 @@
+"""stellar_tpu — a TPU-native validator framework with stellar-core's capabilities.
+
+Layer map (mirrors SURVEY.md §1; each subpackage documents its reference
+counterpart):
+
+- ``xdr``        wire protocol (xdrpp/xdrc equivalent, byte-exact)
+- ``crypto``     hashing, keys, strkey, SigBackend (incl. TPU batch verify)
+- ``ops``        JAX/Pallas kernels: ed25519 field/curve math on TPU
+- ``parallel``   device-mesh sharding of the crypto data plane
+- ``util``       VirtualClock event loop, metrics, logging, streams
+- ``database``   SQL hot state (sqlite)
+- ``ledger``     ledger state machine (frames, delta, manager)
+- ``tx``         transactions + 10 operation types + order book
+- ``scp``        Stellar Consensus Protocol library
+- ``herder``     consensus glue (txsets, pending envelopes)
+- ``overlay``    authenticated P2P flood mesh
+- ``bucket``     log-structured 11-level bucket list
+- ``history``    checkpoint publish/catchup state machines
+- ``process``    async subprocess management
+- ``main``       Application composition root, config, CLI, admin HTTP
+- ``simulation`` in-process multi-node simulation + load generation
+"""
+
+__version__ = "0.1.0"

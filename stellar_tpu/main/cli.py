@@ -1,0 +1,20 @@
+"""CLI entry point (reference: src/main/main.cpp).
+
+Grows the reference's flag set (--newdb, --conf, --c cmd, --genseed,
+--dumpxdr, --test, ...) as the subsystems land.
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    print("stellar-tpu: validator node (subsystems under construction)")
+    print("usage: stellar-tpu [--conf FILE] [--newdb] [--genseed] ...")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

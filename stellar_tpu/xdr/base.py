@@ -1,0 +1,542 @@
+"""Declarative XDR (RFC 4506) runtime.
+
+This is the TPU-native framework's replacement for the reference's xdrpp +
+``xdrc`` code generator (reference: lib/xdrpp, src/Makefile.am:15-19): instead
+of generating C++ from ``.x`` files, protocol types are declared once in Python
+(see siblings ``xtypes.py``, ``scp.py``, ``entries.py``, ``txs.py``,
+``ledger.py``, ``overlay.py``) and this module derives byte-exact
+pack/unpack — ``xdr_to_opaque`` here must produce the identical octet stream
+xdrpp's ``xdr_to_opaque`` produces, because every hash in the system
+(tx contents hash, txset hash, bucket hashes, ledger header hash) is a SHA-256
+over these bytes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import struct
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+__all__ = [
+    "XdrError",
+    "XdrCodec",
+    "uint32",
+    "int32",
+    "uint64",
+    "int64",
+    "xbool",
+    "opaque",
+    "var_opaque",
+    "string",
+    "array",
+    "var_array",
+    "option",
+    "xenum",
+    "xstruct",
+    "xunion",
+    "xf",
+    "codec_of",
+    "pack",
+    "unpack",
+    "xdr_to_opaque",
+]
+
+
+class XdrError(Exception):
+    """Malformed or out-of-bounds XDR data."""
+
+
+_U32 = struct.Struct(">I")
+_I32 = struct.Struct(">i")
+_U64 = struct.Struct(">Q")
+_I64 = struct.Struct(">q")
+
+
+class XdrCodec:
+    """Base codec: packs values into a bytearray, unpacks from a buffer."""
+
+    def pack_into(self, val: Any, out: bytearray) -> None:
+        raise NotImplementedError
+
+    def unpack_from(self, buf: bytes, off: int) -> Tuple[Any, int]:
+        raise NotImplementedError
+
+    def pack(self, val: Any) -> bytes:
+        out = bytearray()
+        self.pack_into(val, out)
+        return bytes(out)
+
+    def unpack(self, data: bytes) -> Any:
+        val, off = self.unpack_from(data, 0)
+        if off != len(data):
+            raise XdrError(f"trailing bytes: consumed {off} of {len(data)}")
+        return val
+
+
+class _UInt32(XdrCodec):
+    def pack_into(self, val, out):
+        if not 0 <= val <= 0xFFFFFFFF:
+            raise XdrError(f"uint32 out of range: {val}")
+        out += _U32.pack(val)
+
+    def unpack_from(self, buf, off):
+        if off + 4 > len(buf):
+            raise XdrError("short buffer for uint32")
+        return _U32.unpack_from(buf, off)[0], off + 4
+
+
+class _Int32(XdrCodec):
+    def pack_into(self, val, out):
+        if not -0x80000000 <= val <= 0x7FFFFFFF:
+            raise XdrError(f"int32 out of range: {val}")
+        out += _I32.pack(val)
+
+    def unpack_from(self, buf, off):
+        if off + 4 > len(buf):
+            raise XdrError("short buffer for int32")
+        return _I32.unpack_from(buf, off)[0], off + 4
+
+
+class _UInt64(XdrCodec):
+    def pack_into(self, val, out):
+        if not 0 <= val <= 0xFFFFFFFFFFFFFFFF:
+            raise XdrError(f"uint64 out of range: {val}")
+        out += _U64.pack(val)
+
+    def unpack_from(self, buf, off):
+        if off + 8 > len(buf):
+            raise XdrError("short buffer for uint64")
+        return _U64.unpack_from(buf, off)[0], off + 8
+
+
+class _Int64(XdrCodec):
+    def pack_into(self, val, out):
+        if not -0x8000000000000000 <= val <= 0x7FFFFFFFFFFFFFFF:
+            raise XdrError(f"int64 out of range: {val}")
+        out += _I64.pack(val)
+
+    def unpack_from(self, buf, off):
+        if off + 8 > len(buf):
+            raise XdrError("short buffer for int64")
+        return _I64.unpack_from(buf, off)[0], off + 8
+
+
+class _Bool(XdrCodec):
+    def pack_into(self, val, out):
+        out += _U32.pack(1 if val else 0)
+
+    def unpack_from(self, buf, off):
+        v, off = uint32.unpack_from(buf, off)
+        if v not in (0, 1):
+            raise XdrError(f"bad bool discriminant {v}")
+        return bool(v), off
+
+
+uint32 = _UInt32()
+int32 = _Int32()
+uint64 = _UInt64()
+int64 = _Int64()
+xbool = _Bool()
+
+
+def _pad(n: int) -> int:
+    return (4 - n % 4) % 4
+
+
+class _Opaque(XdrCodec):
+    """Fixed-length opaque[n]."""
+
+    def __init__(self, n: int):
+        self.n = n
+
+    def pack_into(self, val, out):
+        if len(val) != self.n:
+            raise XdrError(f"opaque[{self.n}] got {len(val)} bytes")
+        out += val
+        out += b"\x00" * _pad(self.n)
+
+    def unpack_from(self, buf, off):
+        end = off + self.n
+        pend = end + _pad(self.n)
+        if pend > len(buf):
+            raise XdrError(f"short buffer for opaque[{self.n}]")
+        if any(buf[end:pend]):
+            raise XdrError("nonzero padding")
+        return bytes(buf[off:end]), pend
+
+
+class _VarOpaque(XdrCodec):
+    """Variable-length opaque<max>."""
+
+    def __init__(self, maxlen: Optional[int] = None):
+        self.maxlen = maxlen if maxlen is not None else 0xFFFFFFFF
+
+    def pack_into(self, val, out):
+        if len(val) > self.maxlen:
+            raise XdrError(f"opaque<{self.maxlen}> got {len(val)} bytes")
+        out += _U32.pack(len(val))
+        out += val
+        out += b"\x00" * _pad(len(val))
+
+    def unpack_from(self, buf, off):
+        n, off = uint32.unpack_from(buf, off)
+        if n > self.maxlen:
+            raise XdrError(f"opaque<{self.maxlen}> length {n}")
+        end = off + n
+        pend = end + _pad(n)
+        if pend > len(buf):
+            raise XdrError("short buffer for var opaque")
+        if any(buf[end:pend]):
+            raise XdrError("nonzero padding")
+        return bytes(buf[off:end]), pend
+
+
+class _String(_VarOpaque):
+    """string<max>; values are ``str``, encoded as the raw bytes on the wire.
+
+    XDR strings are byte strings; we keep them as ``str`` (utf-8/ascii) at the
+    Python level and enforce the byte-length bound like xdrpp does.
+    """
+
+    def pack_into(self, val, out):
+        _VarOpaque.pack_into(self, val.encode("utf-8"), out)
+
+    def unpack_from(self, buf, off):
+        raw, off = _VarOpaque.unpack_from(self, buf, off)
+        try:
+            return raw.decode("utf-8"), off
+        except UnicodeDecodeError as e:
+            raise XdrError(f"invalid string bytes: {e}") from e
+
+
+class _Array(XdrCodec):
+    """Fixed-length array T[n]."""
+
+    def __init__(self, elem: XdrCodec, n: int):
+        self.elem = elem
+        self.n = n
+
+    def pack_into(self, val, out):
+        if len(val) != self.n:
+            raise XdrError(f"array[{self.n}] got {len(val)} elements")
+        for v in val:
+            self.elem.pack_into(v, out)
+
+    def unpack_from(self, buf, off):
+        vals = []
+        for _ in range(self.n):
+            v, off = self.elem.unpack_from(buf, off)
+            vals.append(v)
+        return vals, off
+
+
+class _VarArray(XdrCodec):
+    """Variable-length array T<max>."""
+
+    def __init__(self, elem: XdrCodec, maxlen: Optional[int] = None):
+        self.elem = elem
+        self.maxlen = maxlen if maxlen is not None else 0xFFFFFFFF
+
+    def pack_into(self, val, out):
+        if len(val) > self.maxlen:
+            raise XdrError(f"array<{self.maxlen}> got {len(val)} elements")
+        out += _U32.pack(len(val))
+        for v in val:
+            self.elem.pack_into(v, out)
+
+    def unpack_from(self, buf, off):
+        n, off = uint32.unpack_from(buf, off)
+        if n > self.maxlen:
+            raise XdrError(f"array<{self.maxlen}> length {n}")
+        vals = []
+        for _ in range(n):
+            v, off = self.elem.unpack_from(buf, off)
+            vals.append(v)
+        return vals, off
+
+
+class _Option(XdrCodec):
+    """Optional data (T*): bool-prefixed."""
+
+    def __init__(self, elem: XdrCodec):
+        self.elem = elem
+
+    def pack_into(self, val, out):
+        if val is None:
+            out += _U32.pack(0)
+        else:
+            out += _U32.pack(1)
+            self.elem.pack_into(val, out)
+
+    def unpack_from(self, buf, off):
+        present, off = xbool.unpack_from(buf, off)
+        if not present:
+            return None, off
+        return self.elem.unpack_from(buf, off)
+
+
+class _Enum(XdrCodec):
+    def __init__(self, enum_cls):
+        self.enum_cls = enum_cls
+
+    def pack_into(self, val, out):
+        try:
+            val = self.enum_cls(val)
+        except ValueError as e:
+            raise XdrError(
+                f"bad {self.enum_cls.__name__} value {val!r}"
+            ) from e
+        out += _I32.pack(int(val))
+
+    def unpack_from(self, buf, off):
+        v, off = int32.unpack_from(buf, off)
+        try:
+            return self.enum_cls(v), off
+        except ValueError as e:
+            raise XdrError(f"bad {self.enum_cls.__name__} value {v}") from e
+
+
+def opaque(n: int) -> XdrCodec:
+    return _Opaque(n)
+
+
+def var_opaque(maxlen: Optional[int] = None) -> XdrCodec:
+    return _VarOpaque(maxlen)
+
+
+def string(maxlen: Optional[int] = None) -> XdrCodec:
+    return _String(maxlen)
+
+
+def array(elem: XdrCodec, n: int) -> XdrCodec:
+    return _Array(elem, n)
+
+
+def var_array(elem: XdrCodec, maxlen: Optional[int] = None) -> XdrCodec:
+    return _VarArray(elem, maxlen)
+
+
+def option(elem: XdrCodec) -> XdrCodec:
+    return _Option(elem)
+
+
+_ENUM_CODECS: Dict[type, _Enum] = {}
+
+
+def xenum(enum_cls):
+    """Register an IntEnum as an XDR enum; returns its codec."""
+    codec = _ENUM_CODECS.get(enum_cls)
+    if codec is None:
+        codec = _Enum(enum_cls)
+        _ENUM_CODECS[enum_cls] = codec
+    return codec
+
+
+def xf(codec: XdrCodec, default: Any = dataclasses.MISSING, factory: Any = None):
+    """Declare a dataclass field carrying its XDR codec in metadata.
+
+    Fields with no explicit default get ``None`` so positional/keyword
+    construction stays flexible; packing a ``None`` required field raises.
+    """
+    kw: Dict[str, Any] = {"metadata": {"xdr": codec}}
+    if factory is not None:
+        kw["default_factory"] = factory
+    elif default is not dataclasses.MISSING:
+        kw["default"] = default
+    else:
+        kw["default"] = None
+    return dataclasses.field(**kw)
+
+
+class _StructCodec(XdrCodec):
+    def __init__(self, cls, fields: List[Tuple[str, XdrCodec]]):
+        self.cls = cls
+        self.fields = fields
+
+    def pack_into(self, val, out):
+        for name, codec in self.fields:
+            try:
+                codec.pack_into(getattr(val, name), out)
+            except XdrError:
+                raise
+            except Exception as e:
+                raise XdrError(
+                    f"packing {self.cls.__name__}.{name}: {e}"
+                ) from e
+
+    def unpack_from(self, buf, off):
+        kw = {}
+        for name, codec in self.fields:
+            kw[name], off = codec.unpack_from(buf, off)
+        return self.cls(**kw), off
+
+
+def xstruct(cls):
+    """Decorator: dataclass + XDR codec derived from ``xf`` field metadata."""
+    cls = dataclass(cls)
+    fields = []
+    for f in dataclasses.fields(cls):
+        codec = f.metadata.get("xdr")
+        if codec is None:
+            raise TypeError(f"{cls.__name__}.{f.name} lacks xdr metadata")
+        fields.append((f.name, codec))
+    cls._codec = _StructCodec(cls, fields)
+    cls.to_xdr = lambda self: self._codec.pack(self)
+    cls.from_xdr = classmethod(lambda c, data: c._codec.unpack(data))
+    return cls
+
+
+class _UnionCodec(XdrCodec):
+    def __init__(self, cls, switch_codec, arms, default_void):
+        self.cls = cls
+        self.switch_codec = switch_codec
+        self.arms = arms  # discriminant -> codec | None (void)
+        self.default_void = default_void
+
+    def _arm_codec(self, disc):
+        try:
+            return self.arms[disc]
+        except KeyError:
+            if self.default_void:
+                return None
+            raise XdrError(
+                f"{self.cls.__name__}: bad discriminant {disc!r}"
+            ) from None
+
+    def pack_into(self, val, out):
+        try:
+            self.switch_codec.pack_into(val.type, out)
+        except XdrError:
+            raise
+        except Exception as e:
+            raise XdrError(
+                f"{self.cls.__name__}: bad discriminant {val.type!r}: {e}"
+            ) from e
+        codec = self._arm_codec(val.type)
+        if codec is not None:
+            codec.pack_into(val.value, out)
+        elif val.value is not None:
+            raise XdrError(
+                f"{self.cls.__name__}: void arm {val.type!r} carries a value"
+            )
+
+    def unpack_from(self, buf, off):
+        disc, off = self.switch_codec.unpack_from(buf, off)
+        codec = self._arm_codec(disc)
+        if codec is None:
+            return self.cls(disc, None), off
+        v, off = codec.unpack_from(buf, off)
+        return self.cls(disc, v), off
+
+
+def xunion(switch_codec, arms: Dict[Any, Optional[XdrCodec]], default_void=False):
+    """Class decorator for XDR unions.
+
+    The decorated class becomes a dataclass with fields ``type`` and ``value``
+    plus one read-only property per named arm.  ``arms`` maps discriminant ->
+    (name, codec) for data arms or (name, None)/None for void arms.
+    """
+
+    def deco(cls):
+        cls = dataclass(cls) if not dataclasses.is_dataclass(cls) else cls
+        names = {f.name for f in dataclasses.fields(cls)}
+        if not {"type", "value"} <= names:
+            raise TypeError(f"{cls.__name__} must declare 'type' and 'value' fields")
+        norm_arms: Dict[Any, Optional[XdrCodec]] = {}
+        for disc, spec in arms.items():
+            if spec is None:
+                norm_arms[disc] = None
+                continue
+            name, codec = spec
+            norm_arms[disc] = codec
+            if name:
+                def _mk(d):
+                    def get(self):
+                        if self.type != d:
+                            raise ValueError(
+                                f"{cls.__name__} is {self.type!r}, not {d!r}"
+                            )
+                        return self.value
+                    return get
+                setattr(cls, name, property(_mk(disc)))
+        cls._codec = _UnionCodec(cls, switch_codec, norm_arms, default_void)
+        cls.to_xdr = lambda self: self._codec.pack(self)
+        cls.from_xdr = classmethod(lambda c, data: c._codec.unpack(data))
+        return cls
+
+    return deco
+
+
+class DepthLimited(XdrCodec):
+    """Bounds recursion for self-referential types (e.g. SCPQuorumSet), so a
+    crafted wire message deepens into XdrError instead of RecursionError."""
+
+    def __init__(self, inner: Optional[XdrCodec] = None, max_depth: int = 8):
+        self.inner = inner
+        self.max_depth = max_depth
+        self._depth = 0
+
+    def _enter(self):
+        self._depth += 1
+        if self._depth > self.max_depth:
+            self._depth -= 1
+            raise XdrError(f"recursion deeper than {self.max_depth}")
+
+    def pack_into(self, val, out):
+        self._enter()
+        try:
+            self.inner.pack_into(val, out)
+        finally:
+            self._depth -= 1
+
+    def unpack_from(self, buf, off):
+        self._enter()
+        try:
+            return self.inner.unpack_from(buf, off)
+        finally:
+            self._depth -= 1
+
+
+def codec_of(obj_or_cls) -> XdrCodec:
+    cls = obj_or_cls if isinstance(obj_or_cls, type) else type(obj_or_cls)
+    codec = getattr(cls, "_codec", None)
+    if codec is None:
+        raise TypeError(f"{cls.__name__} is not an XDR type")
+    return codec
+
+
+def pack(val: Any, codec: Optional[XdrCodec] = None) -> bytes:
+    return (codec or codec_of(val)).pack(val)
+
+
+def unpack(cls, data: bytes) -> Any:
+    return codec_of(cls).unpack(data)
+
+
+def xdr_to_opaque(*items: Any) -> bytes:
+    """Concatenated XDR encoding of several values, matching xdrpp's
+    variadic ``xdr_to_opaque`` (the form used for hash preimages, e.g.
+    TransactionFrame.cpp:60 and HerderImpl.cpp:343).
+
+    Each item is either an instance of an ``xstruct``/``xunion`` class, a
+    ``(codec, value)`` tuple, an IntEnum registered with ``xenum``, or raw
+    32-byte ``bytes`` (packed as opaque[32] — the Hash/uint256 case).
+    """
+    out = bytearray()
+    for it in items:
+        if isinstance(it, tuple) and len(it) == 2 and isinstance(it[0], XdrCodec):
+            it[0].pack_into(it[1], out)
+        elif isinstance(it, enum.IntEnum):
+            xenum(type(it)).pack_into(it, out)
+        elif isinstance(it, (bytes, bytearray)):
+            if len(it) != 32:
+                raise XdrError(
+                    "raw bytes in xdr_to_opaque must be 32-byte hashes; "
+                    "use (codec, value) otherwise"
+                )
+            _Opaque(32).pack_into(bytes(it), out)
+        else:
+            codec_of(it).pack_into(it, out)
+    return bytes(out)

@@ -1,0 +1,16 @@
+"""Test configuration.
+
+Tests run on a virtual 8-device CPU mesh (the reference's multi-node story is
+in-process simulation over a shared clock, SURVEY.md §4; our multi-chip story
+is jax.sharding over a Mesh, validated here without TPU hardware).  The real
+TPU chip is exercised by ``bench.py``, not by the unit suite.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
