@@ -1,0 +1,133 @@
+"""Key management: SecretKey / PubKeyUtils (reference: src/crypto/SecretKey.*).
+
+Signing and eager verification go through libsodium (ctypes, see sodium.py);
+verification results are memoized in the global LRU cache exactly like the
+reference's gVerifySigCache (SecretKey.cpp:29-52): 65,535 entries keyed
+SHA256(pubkey ‖ sig ‖ msg), with hit/miss counters surfaced to metrics.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Tuple
+
+from ..xdr.xtypes import PublicKey
+from . import sodium, strkey
+from .sha import sha256
+from .sigcache import VerifySigCache
+
+# process-wide verify cache (reference SecretKey.cpp:30: lru_cache(0xffff))
+_verify_cache = VerifySigCache(0xFFFF)
+
+
+class SecretKey:
+    """Ed25519 secret key wrapping a libsodium (seed, sk64) pair."""
+
+    __slots__ = ("_seed", "_sk64", "_pk_raw", "_pk")
+
+    def __init__(self, seed: bytes):
+        if len(seed) != 32:
+            raise ValueError("seed must be 32 bytes")
+        self._seed = bytes(seed)
+        self._pk_raw, self._sk64 = sodium.sign_seed_keypair(self._seed)
+        self._pk = PublicKey.from_ed25519(self._pk_raw)
+
+    # -- constructors ------------------------------------------------------
+    @classmethod
+    def random(cls) -> "SecretKey":
+        return cls(sodium.randombytes(32))
+
+    @classmethod
+    def from_seed(cls, seed: bytes) -> "SecretKey":
+        return cls(seed)
+
+    @classmethod
+    def from_strkey_seed(cls, s: str) -> "SecretKey":
+        return cls(strkey.from_seed_strkey(s))
+
+    @classmethod
+    def pseudo_random_for_testing(cls, n: int) -> "SecretKey":
+        """Deterministic per-index test key (the reference's getTestAccount
+        style: derived, reproducible, NOT secure)."""
+        return cls(sha256(b"stellar_tpu test seed %d" % n))
+
+    # -- accessors ---------------------------------------------------------
+    def get_public_key(self) -> PublicKey:
+        return self._pk
+
+    @property
+    def public_raw(self) -> bytes:
+        return self._pk_raw
+
+    def get_seed(self) -> bytes:
+        return self._seed
+
+    def get_strkey_seed(self) -> str:
+        return strkey.to_seed_strkey(self._seed)
+
+    def get_strkey_public(self) -> str:
+        return strkey.to_account_strkey(self._pk_raw)
+
+    # -- operations --------------------------------------------------------
+    def sign(self, msg: bytes) -> bytes:
+        return sodium.sign_detached(msg, self._sk64)
+
+    def __repr__(self):
+        return f"SecretKey({self.get_strkey_public()[:8]}…)"
+
+
+class PubKeyUtils:
+    """Static helpers mirroring the reference's PubKeyUtils."""
+
+    @staticmethod
+    def verify_sig(key: PublicKey, signature: bytes, msg: bytes) -> bool:
+        """Cached eager verify (SecretKey.cpp:254-286)."""
+        cache_key = _verify_cache.key_for(key.value, signature, msg)
+        hit, val = _verify_cache.get(cache_key)
+        if hit:
+            return val
+        ok = sodium.verify_detached(signature, msg, key.value)
+        _verify_cache.put(cache_key, ok)
+        return ok
+
+    @staticmethod
+    def verify_sig_uncached(key_raw: bytes, signature: bytes, msg: bytes) -> bool:
+        return sodium.verify_detached(signature, msg, key_raw)
+
+    @staticmethod
+    def get_hint(pk: PublicKey) -> bytes:
+        """Last 4 bytes of the public key (SecretKey.cpp:333-338)."""
+        return pk.value[-4:]
+
+    @staticmethod
+    def has_hint(pk: PublicKey, hint: bytes) -> bool:
+        return pk.value[-4:] == hint
+
+    @staticmethod
+    def to_short_string(pk: PublicKey) -> str:
+        return strkey.to_account_strkey(pk.value)[:8]
+
+    @staticmethod
+    def to_strkey(pk: PublicKey) -> str:
+        return strkey.to_account_strkey(pk.value)
+
+    @staticmethod
+    def from_strkey(s: str) -> PublicKey:
+        return PublicKey.from_ed25519(strkey.from_account_strkey(s))
+
+    @staticmethod
+    def random() -> PublicKey:
+        return PublicKey.from_ed25519(sodium.randombytes(32))
+
+    # cache introspection (SecretKey.cpp:241-252)
+    @staticmethod
+    def flush_verify_sig_cache_counts() -> Tuple[int, int]:
+        return _verify_cache.flush_counts()
+
+    @staticmethod
+    def clear_verify_sig_cache() -> None:
+        _verify_cache.clear()
+
+
+def verify_cache() -> VerifySigCache:
+    return _verify_cache

@@ -1,0 +1,106 @@
+"""SigBackend — the batched signature-verification abstraction.
+
+This is the north-star design point of the framework (BASELINE.json): the
+reference calls libsodium inline at three sites (SURVEY.md §2.8); here every
+verify is expressed as a *batch* of (pubkey, msg, sig) triples so the hot
+paths (TxSetFrame.check_valid, Herder.verify_envelope, ledger close) can
+flush hundreds-to-thousands of verifies at once onto the TPU.
+
+Selected via config ``SIGNATURE_BACKEND = "cpu" | "tpu"`` (the reference has
+no such knob; its equivalent is the hardwired libsodium call at
+SecretKey.cpp:277-279).  Both backends sit behind the same global verify
+cache, so eager single verifies (PubKeyUtils.verify_sig) and batch verifies
+share memoization exactly like the reference's gVerifySigCache.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Sequence, Tuple
+
+from . import sodium
+from .sigcache import VerifySigCache
+
+VerifyTriple = Tuple[bytes, bytes, bytes]  # (pubkey32, msg, sig64)
+
+
+class SigBackend:
+    name = "abstract"
+
+    def verify_batch(self, items: Sequence[VerifyTriple]) -> List[bool]:
+        raise NotImplementedError
+
+    def stats(self) -> dict:
+        return {}
+
+
+class CachingSigBackend(SigBackend):
+    """Wraps an inner backend with the shared verify cache: cached results
+    are served immediately, only misses reach the inner backend, and results
+    scatter back into the cache."""
+
+    def __init__(self, inner: SigBackend, cache: VerifySigCache):
+        self.inner = inner
+        self.cache = cache
+        self.name = inner.name
+
+    def verify_batch(self, items: Sequence[VerifyTriple]) -> List[bool]:
+        keys = [self.cache.key_for(pk, sig, msg) for pk, msg, sig in items]
+        cached = self.cache.peek_many(keys)
+        miss_idx = [i for i, c in enumerate(cached) if c is None]
+        if miss_idx:
+            fresh = self.inner.verify_batch([items[i] for i in miss_idx])
+            self.cache.put_many(
+                (keys[i], ok) for i, ok in zip(miss_idx, fresh)
+            )
+            for i, ok in zip(miss_idx, fresh):
+                cached[i] = ok
+        return [bool(c) for c in cached]
+
+    def stats(self) -> dict:
+        return self.inner.stats()
+
+
+class CpuSigBackend(SigBackend):
+    """libsodium loop — the reference's exact behavior, one verify at a time
+    (crypto_sign_verify_detached, SecretKey.cpp:277-279)."""
+
+    name = "cpu"
+
+    def verify_batch(self, items: Sequence[VerifyTriple]) -> List[bool]:
+        return [
+            sodium.verify_detached(sig, msg, pk) for pk, msg, sig in items
+        ]
+
+
+class TpuSigBackend(SigBackend):
+    """JAX batched ed25519 verify: strict canonicity/small-order prechecks and
+    SHA-512 reduction on host, curve math (decompress + double-scalar-mult)
+    on the accelerator.  Bit-exact with libsodium by construction + the
+    differential test suite (tests/test_ed25519_tpu.py)."""
+
+    name = "tpu"
+
+    def __init__(self, max_batch: int = 4096, mesh=None):
+        from ..ops.ed25519 import BatchVerifier  # lazy: JAX import
+
+        self._verifier = BatchVerifier(max_batch=max_batch, mesh=mesh)
+
+    def verify_batch(self, items: Sequence[VerifyTriple]) -> List[bool]:
+        return self._verifier.verify(items)
+
+    def stats(self) -> dict:
+        return self._verifier.stats()
+
+
+def make_backend(kind: str = "cpu", cache: VerifySigCache = None, **kw) -> SigBackend:
+    if kind == "cpu":
+        inner: SigBackend = CpuSigBackend()
+    elif kind == "tpu":
+        inner = TpuSigBackend(**kw)
+    else:
+        raise ValueError(f"unknown SIGNATURE_BACKEND {kind!r}")
+    if cache is None:
+        from .keys import verify_cache
+
+        cache = verify_cache()
+    return CachingSigBackend(inner, cache)

@@ -1,0 +1,148 @@
+"""SQL hot-state store (reference: src/database/Database.{h,cpp} over SOCI).
+
+sqlite3-backed (the reference's default is ``sqlite3://:memory:`` too;
+postgres is out of scope in this environment).  Provides:
+
+- connection-string parsing ("sqlite3://:memory:" | "sqlite3://<path>")
+- nested transactions via a SAVEPOINT stack — the reference nests a SQL
+  savepoint per transaction-apply inside the ledger-close transaction
+  (TransactionFrame.cpp:439-495)
+- per-query-name medida timers (Database.h getQueryTimer)
+- schema creation/versioning distributed across subsystems' ``drop_all``
+  (Database.cpp:247-256, upgradeToCurrentSchema)
+"""
+
+from __future__ import annotations
+
+import sqlite3
+import time
+from contextlib import contextmanager
+from typing import Any, Iterable, List, Optional, Tuple
+
+SCHEMA_VERSION = 1
+
+
+class Database:
+    def __init__(self, connection_string: str = "sqlite3://:memory:", metrics=None):
+        self.connection_string = connection_string
+        path = self._parse(connection_string)
+        self._conn = sqlite3.connect(path, isolation_level=None)
+        self._conn.execute("PRAGMA journal_mode=MEMORY" if path == ":memory:"
+                           else "PRAGMA journal_mode=WAL")
+        self._conn.execute("PRAGMA synchronous=OFF")
+        self._metrics = metrics
+        self._tx_depth = 0
+        self._sp_counter = 0
+        self.excluded_time = 0.0  # DBTimeExcluder support
+
+    @staticmethod
+    def _parse(cs: str) -> str:
+        if cs.startswith("sqlite3://"):
+            return cs[len("sqlite3://") :]
+        raise ValueError(f"unsupported DATABASE connection string: {cs}")
+
+    # -- raw access --------------------------------------------------------
+    def execute(self, sql: str, params: Iterable = ()) -> sqlite3.Cursor:
+        return self._conn.execute(sql, tuple(params))
+
+    def executemany(self, sql: str, rows) -> sqlite3.Cursor:
+        return self._conn.executemany(sql, rows)
+
+    def query_one(self, sql: str, params: Iterable = ()) -> Optional[Tuple]:
+        return self._conn.execute(sql, tuple(params)).fetchone()
+
+    def query_all(self, sql: str, params: Iterable = ()) -> List[Tuple]:
+        return self._conn.execute(sql, tuple(params)).fetchall()
+
+    # -- timed access (reference: getSelect/Insert/Update/DeleteTimer) ------
+    @contextmanager
+    def timed(self, op: str, entity: str):
+        if self._metrics is None:
+            yield
+            return
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._metrics.new_timer(("database", op, entity)).update(
+                time.perf_counter() - t0
+            )
+
+    # -- transactions ------------------------------------------------------
+    @contextmanager
+    def transaction(self):
+        """Nestable: outermost is BEGIN/COMMIT, inner levels are SAVEPOINTs.
+        Raising inside the block rolls back that level only."""
+        if self._tx_depth == 0:
+            self._conn.execute("BEGIN")
+            self._tx_depth += 1
+            try:
+                yield self
+            except BaseException:
+                self._tx_depth -= 1
+                self._conn.execute("ROLLBACK")
+                raise
+            else:
+                self._tx_depth -= 1
+                self._conn.execute("COMMIT")
+        else:
+            self._sp_counter += 1
+            sp = f"sp_{self._sp_counter}"
+            self._conn.execute(f"SAVEPOINT {sp}")
+            self._tx_depth += 1
+            try:
+                yield self
+            except BaseException:
+                self._tx_depth -= 1
+                self._conn.execute(f"ROLLBACK TO SAVEPOINT {sp}")
+                self._conn.execute(f"RELEASE SAVEPOINT {sp}")
+                raise
+            else:
+                self._tx_depth -= 1
+                self._conn.execute(f"RELEASE SAVEPOINT {sp}")
+
+    @property
+    def in_transaction(self) -> bool:
+        return self._tx_depth > 0
+
+    # -- schema ------------------------------------------------------------
+    def initialize(self) -> None:
+        """(Re)create all subsystem tables (Database::initialize calls every
+        subsystem's dropAll, Database.cpp:247-256)."""
+        from ..ledger.accountframe import AccountFrame
+        from ..ledger.trustframe import TrustFrame
+        from ..ledger.offerframe import OfferFrame
+        from ..ledger.headerframe import LedgerHeaderFrame
+        from ..main.persistentstate import PersistentState
+        from ..tx.history import drop_tx_history
+        from ..overlay.peerrecord import PeerRecord
+        from ..history.publish import drop_publish_queue
+        from ..main.externalqueue import ExternalQueue
+
+        for dropper in (
+            AccountFrame.drop_all,
+            OfferFrame.drop_all,
+            TrustFrame.drop_all,
+            PeerRecord.drop_all,
+            PersistentState.drop_all,
+            ExternalQueue.drop_all,
+            LedgerHeaderFrame.drop_all,
+            drop_tx_history,
+            drop_publish_queue,
+        ):
+            dropper(self)
+        self.put_schema_version(SCHEMA_VERSION)
+
+    def get_schema_version(self) -> int:
+        from ..main.persistentstate import PersistentState
+
+        v = PersistentState(self).get_state("databaseschema")
+        return int(v) if v else 0
+
+    def put_schema_version(self, v: int) -> None:
+        from ..main.persistentstate import PersistentState
+
+        PersistentState(self).set_state("databaseschema", str(v))
+
+    def close(self) -> None:
+        self._conn.close()

@@ -1,0 +1,33 @@
+"""Crash-safe publish queue table (reference: HistoryManagerImpl.cpp:48-53,
+publishqueue; snapshots queue inside the ledger-close SQL transaction at
+LedgerManagerImpl.cpp:710-736 so a crash never loses a checkpoint).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+
+def drop_publish_queue(db) -> None:
+    db.execute("DROP TABLE IF EXISTS publishqueue")
+    db.execute(
+        """CREATE TABLE publishqueue (
+            ledger   INTEGER PRIMARY KEY,
+            state    TEXT
+        )"""
+    )
+
+
+def queue_checkpoint(db, ledger_seq: int, state_json: str) -> None:
+    db.execute(
+        "INSERT OR REPLACE INTO publishqueue (ledger, state) VALUES (?,?)",
+        (ledger_seq, state_json),
+    )
+
+
+def queued_checkpoints(db) -> List[tuple]:
+    return db.query_all("SELECT ledger, state FROM publishqueue ORDER BY ledger")
+
+
+def dequeue_checkpoint(db, ledger_seq: int) -> None:
+    db.execute("DELETE FROM publishqueue WHERE ledger=?", (ledger_seq,))

@@ -1,0 +1,262 @@
+"""AccountFrame: accounts + signers tables (reference: src/ledger/AccountFrame.*)."""
+
+from __future__ import annotations
+
+import base64
+from typing import List, Optional
+
+from ..crypto import strkey
+from ..xdr.entries import (
+    AccountEntry,
+    AccountFlags,
+    LedgerEntry,
+    LedgerEntryData,
+    LedgerEntryType,
+    PublicKey,
+    Signer,
+    ThresholdIndexes,
+)
+from ..xdr.ledger import LedgerKey, LedgerKeyAccount
+from .entryframe import EntryFrame
+
+
+def _aid(pk: PublicKey) -> str:
+    return strkey.to_account_strkey(pk.value)
+
+
+def _from_aid(s: str) -> PublicKey:
+    return PublicKey.from_ed25519(strkey.from_account_strkey(s))
+
+
+class AccountFrame(EntryFrame):
+    entry_type = LedgerEntryType.ACCOUNT
+
+    def __init__(self, entry: LedgerEntry = None, account_id: PublicKey = None):
+        if entry is None:
+            ae = AccountEntry(
+                accountID=account_id,
+                balance=0,
+                seqNum=0,
+                numSubEntries=0,
+                inflationDest=None,
+                flags=0,
+                homeDomain="",
+                thresholds=b"\x01\x00\x00\x00",  # master weight 1
+                signers=[],
+                ext=0,
+            )
+            entry = LedgerEntry(0, LedgerEntryData(LedgerEntryType.ACCOUNT, ae), 0)
+        self.account: AccountEntry = entry.data.value
+        super().__init__(entry)
+
+    def _compute_key(self) -> LedgerKey:
+        return LedgerKey(
+            LedgerEntryType.ACCOUNT, LedgerKeyAccount(self.account.accountID)
+        )
+
+    # -- accessors (AccountFrame.h:60-100) ---------------------------------
+    def get_id(self) -> PublicKey:
+        return self.account.accountID
+
+    def get_balance(self) -> int:
+        return self.account.balance
+
+    def set_balance(self, v: int) -> None:
+        self.account.balance = v
+
+    def add_balance(self, delta: int) -> bool:
+        new = self.account.balance + delta
+        if new < 0:
+            return False
+        self.account.balance = new
+        return True
+
+    def get_seq_num(self) -> int:
+        return self.account.seqNum
+
+    def set_seq_num(self, v: int) -> None:
+        self.account.seqNum = v
+
+    def get_num_sub_entries(self) -> int:
+        return self.account.numSubEntries
+
+    def is_auth_required(self) -> bool:
+        return bool(self.account.flags & AccountFlags.AUTH_REQUIRED_FLAG)
+
+    def is_auth_revocable(self) -> bool:
+        return bool(self.account.flags & AccountFlags.AUTH_REVOCABLE_FLAG)
+
+    def is_immutable_auth(self) -> bool:
+        return bool(self.account.flags & AccountFlags.AUTH_IMMUTABLE_FLAG)
+
+    def get_master_weight(self) -> int:
+        return self.account.thresholds[ThresholdIndexes.THRESHOLD_MASTER_WEIGHT]
+
+    def get_low_threshold(self) -> int:
+        return self.account.thresholds[ThresholdIndexes.THRESHOLD_LOW]
+
+    def get_medium_threshold(self) -> int:
+        return self.account.thresholds[ThresholdIndexes.THRESHOLD_MED]
+
+    def get_high_threshold(self) -> int:
+        return self.account.thresholds[ThresholdIndexes.THRESHOLD_HIGH]
+
+    def get_minimum_balance(self, lm) -> int:
+        return lm.get_min_balance(self.account.numSubEntries)
+
+    def get_balance_above_reserve(self, lm) -> int:
+        avail = self.get_balance() - lm.get_min_balance(self.account.numSubEntries)
+        return max(avail, 0)
+
+    def add_num_entries(self, count: int, lm) -> bool:
+        """Adjust numSubEntries, enforcing reserve on increase
+        (AccountFrame.cpp:150-166)."""
+        new_count = self.account.numSubEntries + count
+        if count > 0 and self.get_balance() < lm.get_min_balance(new_count):
+            return False
+        self.account.numSubEntries = new_count
+        return True
+
+    # -- SQL ---------------------------------------------------------------
+    @staticmethod
+    def drop_all(db) -> None:
+        db.execute("DROP TABLE IF EXISTS accounts")
+        db.execute("DROP TABLE IF EXISTS signers")
+        db.execute(
+            """CREATE TABLE accounts (
+                accountid     VARCHAR(56) PRIMARY KEY,
+                balance       BIGINT NOT NULL CHECK (balance >= 0),
+                seqnum        BIGINT NOT NULL,
+                numsubentries INT NOT NULL CHECK (numsubentries >= 0),
+                inflationdest VARCHAR(56),
+                homedomain    VARCHAR(32) NOT NULL,
+                thresholds    TEXT NOT NULL,
+                flags         INT NOT NULL,
+                lastmodified  INT NOT NULL
+            )"""
+        )
+        db.execute(
+            """CREATE TABLE signers (
+                accountid VARCHAR(56) NOT NULL,
+                publickey VARCHAR(56) NOT NULL,
+                weight    INT NOT NULL,
+                PRIMARY KEY (accountid, publickey)
+            )"""
+        )
+        db.execute("CREATE INDEX accountbalances ON accounts (balance)")
+        entry_cache = getattr(db, "_entry_cache", None)
+        if entry_cache is not None:
+            entry_cache.clear()
+
+    @classmethod
+    def load_account(cls, account_id: PublicKey, db) -> Optional["AccountFrame"]:
+        key = LedgerKey(LedgerEntryType.ACCOUNT, LedgerKeyAccount(account_id))
+        hit, cached = cls.cache_of(db).get(key.to_xdr())
+        if hit:
+            return cls(LedgerEntry.from_xdr(cached)) if cached else None
+        aid = _aid(account_id)
+        with db.timed("select", "account"):
+            row = db.query_one(
+                """SELECT balance, seqnum, numsubentries, inflationdest,
+                          homedomain, thresholds, flags, lastmodified
+                   FROM accounts WHERE accountid=?""",
+                (aid,),
+            )
+        if row is None:
+            cls.store_in_cache(db, key, None)
+            return None
+        (balance, seqnum, numsub, infl, domain, thresholds, flags, lastmod) = row
+        signers = [
+            Signer(_from_aid(pk), w)
+            for pk, w in db.query_all(
+                "SELECT publickey, weight FROM signers WHERE accountid=?"
+                " ORDER BY publickey",
+                (aid,),
+            )
+        ]
+        ae = AccountEntry(
+            accountID=account_id,
+            balance=balance,
+            seqNum=seqnum,
+            numSubEntries=numsub,
+            inflationDest=_from_aid(infl) if infl else None,
+            flags=flags,
+            homeDomain=domain,
+            thresholds=base64.b64decode(thresholds),
+            signers=signers,
+            ext=0,
+        )
+        entry = LedgerEntry(lastmod, LedgerEntryData(LedgerEntryType.ACCOUNT, ae), 0)
+        frame = cls(entry)
+        cls.store_in_cache(db, key, entry)
+        return frame
+
+    @classmethod
+    def exists(cls, db, key: LedgerKey) -> bool:
+        return (
+            db.query_one(
+                "SELECT 1 FROM accounts WHERE accountid=?",
+                (_aid(key.value.accountID),),
+            )
+            is not None
+        )
+
+    def _persist(self, db, insert: bool) -> None:
+        a = self.account
+        params = (
+            a.balance,
+            a.seqNum,
+            a.numSubEntries,
+            _aid(a.inflationDest) if a.inflationDest else None,
+            a.homeDomain,
+            base64.b64encode(a.thresholds).decode(),
+            a.flags,
+            self.last_modified,
+            _aid(a.accountID),
+        )
+        if insert:
+            with db.timed("insert", "account"):
+                db.execute(
+                    """INSERT INTO accounts (balance, seqnum, numsubentries,
+                       inflationdest, homedomain, thresholds, flags,
+                       lastmodified, accountid)
+                       VALUES (?,?,?,?,?,?,?,?,?)""",
+                    params,
+                )
+        else:
+            with db.timed("update", "account"):
+                db.execute(
+                    """UPDATE accounts SET balance=?, seqnum=?, numsubentries=?,
+                       inflationdest=?, homedomain=?, thresholds=?, flags=?,
+                       lastmodified=? WHERE accountid=?""",
+                    params,
+                )
+        # replace signer rows wholesale (simpler than the reference's diffing,
+        # same observable state)
+        aid = _aid(a.accountID)
+        db.execute("DELETE FROM signers WHERE accountid=?", (aid,))
+        if a.signers:
+            db.executemany(
+                "INSERT INTO signers (accountid, publickey, weight) VALUES (?,?,?)",
+                [(aid, _aid(s.pubKey), s.weight) for s in a.signers],
+            )
+
+    def store_add(self, delta, db) -> None:
+        self._stamp(delta)
+        self._persist(db, insert=True)
+        delta.add_entry(self)
+        self.store_in_cache(db, self.get_key(), self.entry)
+
+    def store_change(self, delta, db) -> None:
+        self._stamp(delta)
+        self._persist(db, insert=False)
+        delta.mod_entry(self)
+        self.store_in_cache(db, self.get_key(), self.entry)
+
+    def store_delete(self, delta, db) -> None:
+        aid = _aid(self.account.accountID)
+        with db.timed("delete", "account"):
+            db.execute("DELETE FROM accounts WHERE accountid=?", (aid,))
+        db.execute("DELETE FROM signers WHERE accountid=?", (aid,))
+        delta.delete_entry_frame(self)
+        self.store_in_cache(db, self.get_key(), None)

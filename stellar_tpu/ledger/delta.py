@@ -1,0 +1,213 @@
+"""LedgerDelta — nestable change-set (reference: src/ledger/LedgerDelta.{h,cpp}).
+
+Tracks created/modified/deleted entries plus header mutation; commits merge
+into the outer delta (or publish to the header at top level); rollbacks drop
+the changes and flush affected entry-cache lines.  Emits LedgerEntryChanges
+meta and live/dead entry lists for the bucket list.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set
+
+from ..xdr.entries import LedgerEntry
+from ..xdr.ledger import (
+    LedgerEntryChange,
+    LedgerEntryChangeType,
+    LedgerKey,
+)
+
+
+class LedgerDelta:
+    def __init__(
+        self,
+        header=None,
+        db=None,
+        update_last_modified: bool = True,
+        outer: "LedgerDelta" = None,
+    ):
+        if outer is not None:
+            self._outer = outer
+            self._db = outer._db
+            self._header_target = None
+            self.header = _copy_header(outer.header)
+            self._previous_header = outer.header
+            self.update_last_modified = outer.update_last_modified
+        else:
+            assert header is not None and db is not None
+            self._outer = None
+            self._db = db
+            self._header_target = header  # committed back on commit()
+            self.header = _copy_header(header)
+            self._previous_header = header
+            self.update_last_modified = update_last_modified
+        # key-xdr -> LedgerEntry (copies)
+        self._new: Dict[bytes, LedgerEntry] = {}
+        self._mod: Dict[bytes, LedgerEntry] = {}
+        self._delete: Set[bytes] = set()
+        self._key_objs: Dict[bytes, LedgerKey] = {}
+        self._open = True
+
+    # -- header ------------------------------------------------------------
+    def get_header(self):
+        return self.header
+
+    def generate_id(self) -> int:
+        self.header.idPool += 1
+        return self.header.idPool
+
+    # -- entry recording (LedgerDelta.cpp addEntry/modEntry/deleteEntry) ----
+    def _remember_key(self, key: LedgerKey) -> bytes:
+        kb = key.to_xdr()
+        self._key_objs[kb] = key
+        return kb
+
+    def add_entry(self, frame) -> None:
+        kb = self._remember_key(frame.get_key())
+        if kb in self._delete:
+            # deleted-then-recreated == modified
+            self._delete.discard(kb)
+            self._mod[kb] = _copy_entry(frame.entry)
+        else:
+            assert kb not in self._new and kb not in self._mod, "double create"
+            self._new[kb] = _copy_entry(frame.entry)
+
+    def mod_entry(self, frame) -> None:
+        kb = self._remember_key(frame.get_key())
+        if kb in self._new:
+            self._new[kb] = _copy_entry(frame.entry)
+        else:
+            assert kb not in self._delete, "modifying deleted entry"
+            self._mod[kb] = _copy_entry(frame.entry)
+
+    def delete_entry_frame(self, frame) -> None:
+        self.delete_entry(frame.get_key())
+
+    def delete_entry(self, key: LedgerKey) -> None:
+        kb = self._remember_key(key)
+        if kb in self._new:
+            # created in this delta, then deleted: net nothing
+            del self._new[kb]
+        else:
+            self._mod.pop(kb, None)
+            self._delete.add(kb)
+
+    # -- commit / rollback -------------------------------------------------
+    def commit(self) -> None:
+        assert self._open
+        self._open = False
+        if self._outer is not None:
+            out = self._outer
+            for kb, e in self._new.items():
+                out._key_objs[kb] = self._key_objs[kb]
+                if kb in out._delete:
+                    out._delete.discard(kb)
+                    out._mod[kb] = e
+                else:
+                    out._new[kb] = e
+            for kb, e in self._mod.items():
+                out._key_objs[kb] = self._key_objs[kb]
+                if kb in out._new:
+                    out._new[kb] = e
+                else:
+                    out._mod[kb] = e
+            for kb in self._delete:
+                out._key_objs[kb] = self._key_objs[kb]
+                if kb in out._new:
+                    del out._new[kb]
+                else:
+                    out._mod.pop(kb, None)
+                    out._delete.add(kb)
+            out.header = _copy_header(self.header)
+        else:
+            _assign_header(self._header_target, self.header)
+
+    def rollback(self) -> None:
+        """Discard changes; flush entry cache for touched keys (the SQL
+        rollback itself is the enclosing Database.transaction's job)."""
+        if not self._open:
+            return
+        self._open = False
+        cache = getattr(self._db, "_entry_cache", None)
+        if cache is not None:
+            for kb in self._key_objs:
+                cache.erase(kb)
+
+    # -- outputs -----------------------------------------------------------
+    def get_live_entries(self) -> List[LedgerEntry]:
+        return list(self._new.values()) + list(self._mod.values())
+
+    def get_dead_entries(self) -> List[LedgerKey]:
+        return [self._key_objs[kb] for kb in self._delete]
+
+    def get_changes(self) -> List[LedgerEntryChange]:
+        changes = []
+        for e in self._new.values():
+            changes.append(
+                LedgerEntryChange(LedgerEntryChangeType.LEDGER_ENTRY_CREATED, e)
+            )
+        for e in self._mod.values():
+            changes.append(
+                LedgerEntryChange(LedgerEntryChangeType.LEDGER_ENTRY_UPDATED, e)
+            )
+        for kb in self._delete:
+            changes.append(
+                LedgerEntryChange(
+                    LedgerEntryChangeType.LEDGER_ENTRY_REMOVED, self._key_objs[kb]
+                )
+            )
+        return changes
+
+    def check_against_database(self, db) -> None:
+        """PARANOID_MODE audit: every live entry must match the DB row
+        (LedgerDelta::checkAgainstDatabase, used at LedgerManagerImpl.cpp:705)."""
+        from .accountframe import AccountFrame
+        from .offerframe import OfferFrame
+        from .trustframe import TrustFrame
+        from ..xdr.entries import LedgerEntryType
+
+        cache = getattr(db, "_entry_cache", None)
+        for kb, entry in {**self._new, **self._mod}.items():
+            key = self._key_objs[kb]
+            if cache is not None:
+                cache.erase(kb)
+            if key.type == LedgerEntryType.ACCOUNT:
+                frame = AccountFrame.load_account(key.value.accountID, db)
+            elif key.type == LedgerEntryType.TRUSTLINE:
+                frame = TrustFrame.load_trust_line(
+                    key.value.accountID, key.value.asset, db
+                )
+            else:
+                frame = OfferFrame.load_offer(key.value.sellerID, key.value.offerID, db)
+            if frame is None or frame.entry.to_xdr() != entry.to_xdr():
+                raise RuntimeError(f"delta-vs-database mismatch for {key}")
+
+
+def _copy_entry(e: LedgerEntry) -> LedgerEntry:
+    return LedgerEntry.from_xdr(e.to_xdr())
+
+
+def _copy_header(h):
+    from ..xdr.ledger import LedgerHeader
+
+    return LedgerHeader.from_xdr(h.to_xdr())
+
+
+def _assign_header(dst, src) -> None:
+    for f in (
+        "ledgerVersion",
+        "previousLedgerHash",
+        "scpValue",
+        "txSetResultHash",
+        "bucketListHash",
+        "ledgerSeq",
+        "totalCoins",
+        "feePool",
+        "inflationSeq",
+        "idPool",
+        "baseFee",
+        "baseReserve",
+        "maxTxSetSize",
+        "skipList",
+    ):
+        setattr(dst, f, getattr(src, f))

@@ -1,0 +1,115 @@
+"""EntryFrame base + process-wide entry cache (reference: src/ledger/EntryFrame.*).
+
+An EntryFrame wraps one XDR LedgerEntry with SQL store/load/delete.  The
+reference keeps a global LRU cache of loaded entries keyed by the XDR of the
+LedgerKey (EntryFrame.cpp cache helpers); ours lives on the Database instance
+so independent Applications in one process (simulation!) don't share state.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Optional
+
+from ..xdr.entries import LedgerEntry, LedgerEntryType
+from ..xdr.ledger import LedgerKey
+
+
+class EntryCache:
+    """Small LRU of key-xdr -> Optional[LedgerEntry-xdr] (None = known-absent)."""
+
+    CAPACITY = 4096
+
+    def __init__(self):
+        self._map: OrderedDict[bytes, Optional[bytes]] = OrderedDict()
+
+    def get(self, key: bytes):
+        if key in self._map:
+            self._map.move_to_end(key)
+            return True, self._map[key]
+        return False, None
+
+    def put(self, key: bytes, entry_xdr: Optional[bytes]):
+        self._map[key] = entry_xdr
+        self._map.move_to_end(key)
+        while len(self._map) > self.CAPACITY:
+            self._map.popitem(last=False)
+
+    def erase(self, key: bytes):
+        self._map.pop(key, None)
+
+    def clear(self):
+        self._map.clear()
+
+
+def entry_cache_of(db) -> EntryCache:
+    cache = getattr(db, "_entry_cache", None)
+    if cache is None:
+        cache = EntryCache()
+        db._entry_cache = cache
+    return cache
+
+
+class EntryFrame:
+    """Base for Account/Trust/Offer frames."""
+
+    entry_type: LedgerEntryType = None
+
+    def __init__(self, entry: LedgerEntry):
+        self.entry = entry
+        self.m_key_calculated = False
+        self._key: Optional[LedgerKey] = None
+
+    # -- identity ----------------------------------------------------------
+    def get_key(self) -> LedgerKey:
+        if not self.m_key_calculated:
+            self._key = self._compute_key()
+            self.m_key_calculated = True
+        return self._key
+
+    def _compute_key(self) -> LedgerKey:
+        raise NotImplementedError
+
+    @property
+    def last_modified(self) -> int:
+        return self.entry.lastModifiedLedgerSeq
+
+    @last_modified.setter
+    def last_modified(self, seq: int):
+        self.entry.lastModifiedLedgerSeq = seq
+
+    def copy(self) -> "EntryFrame":
+        return type(self)(LedgerEntry.from_xdr(self.entry.to_xdr()))
+
+    # -- store interface (implemented by subclasses) -----------------------
+    def store_add(self, delta, db) -> None:
+        raise NotImplementedError
+
+    def store_change(self, delta, db) -> None:
+        raise NotImplementedError
+
+    def store_delete(self, delta, db) -> None:
+        raise NotImplementedError
+
+    # -- shared plumbing ---------------------------------------------------
+    def _stamp(self, delta) -> None:
+        if delta.update_last_modified:
+            self.last_modified = delta.get_header().ledgerSeq
+
+    @staticmethod
+    def cache_of(db) -> EntryCache:
+        return entry_cache_of(db)
+
+    @classmethod
+    def store_in_cache(cls, db, key: LedgerKey, entry: Optional[LedgerEntry]):
+        entry_cache_of(db).put(
+            key.to_xdr(), entry.to_xdr() if entry is not None else None
+        )
+
+    @classmethod
+    def flush_cached(cls, db, key: LedgerKey):
+        entry_cache_of(db).erase(key.to_xdr())
+
+    @staticmethod
+    def check_exists(db, sql: str, params) -> bool:
+        return db.query_one(sql, params) is not None

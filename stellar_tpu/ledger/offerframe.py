@@ -1,0 +1,254 @@
+"""OfferFrame: offers table + order-book queries (reference: src/ledger/OfferFrame.*)."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..crypto import strkey
+from ..xdr.entries import (
+    Asset,
+    LedgerEntry,
+    LedgerEntryData,
+    LedgerEntryType,
+    OfferEntry,
+    OfferEntryFlags,
+    Price,
+    PublicKey,
+)
+from ..xdr.ledger import LedgerKey, LedgerKeyOffer
+from .entryframe import EntryFrame
+from .trustframe import asset_from_cols, asset_to_cols
+
+
+def _aid(pk: PublicKey) -> str:
+    return strkey.to_account_strkey(pk.value)
+
+
+def _from_aid(s: str) -> PublicKey:
+    return PublicKey.from_ed25519(strkey.from_account_strkey(s))
+
+
+class OfferFrame(EntryFrame):
+    entry_type = LedgerEntryType.OFFER
+
+    def __init__(self, entry: LedgerEntry):
+        self.offer: OfferEntry = entry.data.value
+        super().__init__(entry)
+
+    @classmethod
+    def from_manage_op(cls, seller: PublicKey, op) -> "OfferFrame":
+        """Build the offer entry a ManageOffer op would create
+        (OfferFrame::loadOffer-from-op pattern)."""
+        oe = OfferEntry(
+            sellerID=seller,
+            offerID=op.offerID,
+            selling=op.selling,
+            buying=op.buying,
+            amount=op.amount,
+            price=op.price,
+            flags=0,
+            ext=0,
+        )
+        return cls(LedgerEntry(0, LedgerEntryData(LedgerEntryType.OFFER, oe), 0))
+
+    def _compute_key(self) -> LedgerKey:
+        return LedgerKey(
+            LedgerEntryType.OFFER,
+            LedgerKeyOffer(self.offer.sellerID, self.offer.offerID),
+        )
+
+    def get_price(self) -> Price:
+        return self.offer.price
+
+    def get_amount(self) -> int:
+        return self.offer.amount
+
+    def get_seller_id(self) -> PublicKey:
+        return self.offer.sellerID
+
+    def get_offer_id(self) -> int:
+        return self.offer.offerID
+
+    # -- SQL ---------------------------------------------------------------
+    @staticmethod
+    def drop_all(db) -> None:
+        db.execute("DROP TABLE IF EXISTS offers")
+        db.execute(
+            """CREATE TABLE offers (
+                sellerid         VARCHAR(56) NOT NULL,
+                offerid          BIGINT NOT NULL CHECK (offerid >= 0),
+                sellingassettype INT NOT NULL,
+                sellingassetcode VARCHAR(12),
+                sellingissuer    VARCHAR(56),
+                buyingassettype  INT NOT NULL,
+                buyingassetcode  VARCHAR(12),
+                buyingissuer     VARCHAR(56),
+                amount           BIGINT NOT NULL CHECK (amount >= 0),
+                pricen           INT NOT NULL,
+                priced           INT NOT NULL,
+                price            DOUBLE PRECISION NOT NULL,
+                flags            INT NOT NULL,
+                lastmodified     INT NOT NULL,
+                PRIMARY KEY (offerid)
+            )"""
+        )
+        db.execute("CREATE INDEX sellingissuerindex ON offers (sellingissuer)")
+        db.execute("CREATE INDEX buyingissuerindex ON offers (buyingissuer)")
+        db.execute("CREATE INDEX priceindex ON offers (price)")
+
+    @classmethod
+    def _row_to_frame(cls, row) -> "OfferFrame":
+        (
+            sellerid,
+            offerid,
+            satype,
+            sacode,
+            saissuer,
+            batype,
+            bacode,
+            baissuer,
+            amount,
+            pricen,
+            priced,
+            _price,
+            flags,
+            lastmod,
+        ) = row
+        oe = OfferEntry(
+            sellerID=_from_aid(sellerid),
+            offerID=offerid,
+            selling=asset_from_cols(satype, saissuer, sacode),
+            buying=asset_from_cols(batype, baissuer, bacode),
+            amount=amount,
+            price=Price(pricen, priced),
+            flags=flags,
+            ext=0,
+        )
+        return cls(LedgerEntry(lastmod, LedgerEntryData(LedgerEntryType.OFFER, oe), 0))
+
+    _COLS = (
+        "sellerid, offerid, sellingassettype, sellingassetcode, sellingissuer,"
+        " buyingassettype, buyingassetcode, buyingissuer, amount, pricen,"
+        " priced, price, flags, lastmodified"
+    )
+
+    @classmethod
+    def load_offer(
+        cls, seller: PublicKey, offer_id: int, db
+    ) -> Optional["OfferFrame"]:
+        key = LedgerKey(LedgerEntryType.OFFER, LedgerKeyOffer(seller, offer_id))
+        hit, cached = cls.cache_of(db).get(key.to_xdr())
+        if hit:
+            return cls(LedgerEntry.from_xdr(cached)) if cached else None
+        with db.timed("select", "offer"):
+            row = db.query_one(
+                f"SELECT {cls._COLS} FROM offers WHERE sellerid=? AND offerid=?",
+                (_aid(seller), offer_id),
+            )
+        if row is None:
+            cls.store_in_cache(db, key, None)
+            return None
+        frame = cls._row_to_frame(row)
+        cls.store_in_cache(db, key, frame.entry)
+        return frame
+
+    @classmethod
+    def load_best_offers(
+        cls, num: int, offset: int, selling: Asset, buying: Asset, db
+    ) -> List["OfferFrame"]:
+        """Offers selling `selling` for `buying`, cheapest first
+        (OfferFrame::loadBestOffers; order by price then offerid for
+        determinism — consensus-critical!)."""
+        satype, saissuer, sacode = asset_to_cols(selling)
+        batype, baissuer, bacode = asset_to_cols(buying)
+        cond_s = (
+            "sellingassettype=?"
+            if selling.is_native()
+            else "sellingassettype=? AND sellingissuer=? AND sellingassetcode=?"
+        )
+        cond_b = (
+            "buyingassettype=?"
+            if buying.is_native()
+            else "buyingassettype=? AND buyingissuer=? AND buyingassetcode=?"
+        )
+        params: list = [satype] if selling.is_native() else [satype, saissuer, sacode]
+        params += [batype] if buying.is_native() else [batype, baissuer, bacode]
+        params += [num, offset]
+        with db.timed("select", "offer"):
+            rows = db.query_all(
+                f"SELECT {cls._COLS} FROM offers WHERE {cond_s} AND {cond_b} "
+                "ORDER BY price, offerid LIMIT ? OFFSET ?",
+                params,
+            )
+        return [cls._row_to_frame(r) for r in rows]
+
+    @classmethod
+    def exists(cls, db, key: LedgerKey) -> bool:
+        return (
+            db.query_one(
+                "SELECT 1 FROM offers WHERE sellerid=? AND offerid=?",
+                (_aid(key.value.sellerID), key.value.offerID),
+            )
+            is not None
+        )
+
+    def _persist(self, db, insert: bool) -> None:
+        o = self.offer
+        satype, saissuer, sacode = asset_to_cols(o.selling)
+        batype, baissuer, bacode = asset_to_cols(o.buying)
+        price_approx = o.price.n / o.price.d
+        if insert:
+            with db.timed("insert", "offer"):
+                db.execute(
+                    f"""INSERT INTO offers ({self._COLS})
+                        VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?)""",
+                    (
+                        _aid(o.sellerID),
+                        o.offerID,
+                        satype,
+                        sacode,
+                        saissuer,
+                        batype,
+                        bacode,
+                        baissuer,
+                        o.amount,
+                        o.price.n,
+                        o.price.d,
+                        price_approx,
+                        o.flags,
+                        self.last_modified,
+                    ),
+                )
+        else:
+            with db.timed("update", "offer"):
+                db.execute(
+                    """UPDATE offers SET amount=?, pricen=?, priced=?, price=?,
+                       flags=?, lastmodified=? WHERE offerid=?""",
+                    (
+                        o.amount,
+                        o.price.n,
+                        o.price.d,
+                        price_approx,
+                        o.flags,
+                        self.last_modified,
+                        o.offerID,
+                    ),
+                )
+
+    def store_add(self, delta, db) -> None:
+        self._stamp(delta)
+        self._persist(db, insert=True)
+        delta.add_entry(self)
+        self.store_in_cache(db, self.get_key(), self.entry)
+
+    def store_change(self, delta, db) -> None:
+        self._stamp(delta)
+        self._persist(db, insert=False)
+        delta.mod_entry(self)
+        self.store_in_cache(db, self.get_key(), self.entry)
+
+    def store_delete(self, delta, db) -> None:
+        with db.timed("delete", "offer"):
+            db.execute("DELETE FROM offers WHERE offerid=?", (self.offer.offerID,))
+        delta.delete_entry_frame(self)
+        self.store_in_cache(db, self.get_key(), None)

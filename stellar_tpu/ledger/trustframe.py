@@ -1,0 +1,210 @@
+"""TrustFrame: trustlines table (reference: src/ledger/TrustFrame.*)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from ..crypto import strkey
+from ..xdr.entries import (
+    Asset,
+    AssetType,
+    LedgerEntry,
+    LedgerEntryData,
+    LedgerEntryType,
+    PublicKey,
+    TrustLineEntry,
+    TrustLineFlags,
+)
+from ..xdr.ledger import LedgerKey, LedgerKeyTrustLine
+from .entryframe import EntryFrame
+
+
+def _aid(pk: PublicKey) -> str:
+    return strkey.to_account_strkey(pk.value)
+
+
+def _from_aid(s: str) -> PublicKey:
+    return PublicKey.from_ed25519(strkey.from_account_strkey(s))
+
+
+def asset_to_cols(asset: Asset) -> Tuple[int, Optional[str], Optional[str]]:
+    """(assettype, issuer_strkey, code_text)."""
+    if asset.is_native():
+        return int(AssetType.ASSET_TYPE_NATIVE), None, None
+    code, issuer = asset.code_and_issuer()
+    return int(asset.type), _aid(issuer), code.rstrip(b"\x00").decode("ascii")
+
+
+def asset_from_cols(atype: int, issuer: Optional[str], code: Optional[str]) -> Asset:
+    t = AssetType(atype)
+    if t == AssetType.ASSET_TYPE_NATIVE:
+        return Asset.native()
+    issuer_pk = _from_aid(issuer)
+    raw = code.encode("ascii")
+    if t == AssetType.ASSET_TYPE_CREDIT_ALPHANUM4:
+        return Asset.alphanum4(raw, issuer_pk)
+    return Asset.alphanum12(raw, issuer_pk)
+
+
+class TrustFrame(EntryFrame):
+    entry_type = LedgerEntryType.TRUSTLINE
+
+    def __init__(self, entry: LedgerEntry):
+        self.trust_line: TrustLineEntry = entry.data.value
+        super().__init__(entry)
+
+    @classmethod
+    def make(cls, account_id: PublicKey, asset: Asset) -> "TrustFrame":
+        tl = TrustLineEntry(
+            accountID=account_id, asset=asset, balance=0, limit=0, flags=0, ext=0
+        )
+        return cls(LedgerEntry(0, LedgerEntryData(LedgerEntryType.TRUSTLINE, tl), 0))
+
+    def _compute_key(self) -> LedgerKey:
+        return LedgerKey(
+            LedgerEntryType.TRUSTLINE,
+            LedgerKeyTrustLine(self.trust_line.accountID, self.trust_line.asset),
+        )
+
+    # -- accessors ---------------------------------------------------------
+    def get_balance(self) -> int:
+        return self.trust_line.balance
+
+    def add_balance(self, delta: int) -> bool:
+        if self.trust_line.accountID == self.trust_line.asset.code_and_issuer()[1]:
+            return True  # issuer's own line is a no-op (TrustFrame.cpp issuer check)
+        new = self.trust_line.balance + delta
+        if new < 0 or new > self.trust_line.limit:
+            return False
+        self.trust_line.balance = new
+        return True
+
+    def get_max_amount_receive(self) -> int:
+        if self.trust_line.accountID == self.trust_line.asset.code_and_issuer()[1]:
+            return 0x7FFFFFFFFFFFFFFF  # issuer can absorb anything
+        return self.trust_line.limit - self.trust_line.balance
+
+    def is_authorized(self) -> bool:
+        return bool(self.trust_line.flags & TrustLineFlags.AUTHORIZED_FLAG)
+
+    def set_authorized(self, authorized: bool) -> None:
+        if authorized:
+            self.trust_line.flags |= TrustLineFlags.AUTHORIZED_FLAG
+        else:
+            self.trust_line.flags &= ~TrustLineFlags.AUTHORIZED_FLAG
+
+    # -- SQL ---------------------------------------------------------------
+    @staticmethod
+    def drop_all(db) -> None:
+        db.execute("DROP TABLE IF EXISTS trustlines")
+        db.execute(
+            """CREATE TABLE trustlines (
+                accountid   VARCHAR(56) NOT NULL,
+                assettype   INT NOT NULL,
+                issuer      VARCHAR(56) NOT NULL,
+                assetcode   VARCHAR(12) NOT NULL,
+                tlimit      BIGINT NOT NULL CHECK (tlimit >= 0),
+                balance     BIGINT NOT NULL CHECK (balance >= 0),
+                flags       INT NOT NULL,
+                lastmodified INT NOT NULL,
+                PRIMARY KEY (accountid, issuer, assetcode)
+            )"""
+        )
+
+    @classmethod
+    def load_trust_line(
+        cls, account_id: PublicKey, asset: Asset, db
+    ) -> Optional["TrustFrame"]:
+        key = LedgerKey(
+            LedgerEntryType.TRUSTLINE, LedgerKeyTrustLine(account_id, asset)
+        )
+        hit, cached = cls.cache_of(db).get(key.to_xdr())
+        if hit:
+            return cls(LedgerEntry.from_xdr(cached)) if cached else None
+        _, issuer, code = asset_to_cols(asset)
+        with db.timed("select", "trust"):
+            row = db.query_one(
+                """SELECT tlimit, balance, flags, lastmodified FROM trustlines
+                   WHERE accountid=? AND issuer=? AND assetcode=?""",
+                (_aid(account_id), issuer, code),
+            )
+        if row is None:
+            cls.store_in_cache(db, key, None)
+            return None
+        tlimit, balance, flags, lastmod = row
+        tl = TrustLineEntry(account_id, asset, balance, tlimit, flags, 0)
+        entry = LedgerEntry(lastmod, LedgerEntryData(LedgerEntryType.TRUSTLINE, tl), 0)
+        cls.store_in_cache(db, key, entry)
+        return cls(entry)
+
+    @classmethod
+    def exists(cls, db, key: LedgerKey) -> bool:
+        _, issuer, code = asset_to_cols(key.value.asset)
+        return (
+            db.query_one(
+                "SELECT 1 FROM trustlines WHERE accountid=? AND issuer=? AND assetcode=?",
+                (_aid(key.value.accountID), issuer, code),
+            )
+            is not None
+        )
+
+    def _persist(self, db, insert: bool) -> None:
+        tl = self.trust_line
+        atype, issuer, code = asset_to_cols(tl.asset)
+        if insert:
+            with db.timed("insert", "trust"):
+                db.execute(
+                    """INSERT INTO trustlines (accountid, assettype, issuer,
+                       assetcode, tlimit, balance, flags, lastmodified)
+                       VALUES (?,?,?,?,?,?,?,?)""",
+                    (
+                        _aid(tl.accountID),
+                        atype,
+                        issuer,
+                        code,
+                        tl.limit,
+                        tl.balance,
+                        tl.flags,
+                        self.last_modified,
+                    ),
+                )
+        else:
+            with db.timed("update", "trust"):
+                db.execute(
+                    """UPDATE trustlines SET assettype=?, tlimit=?, balance=?,
+                       flags=?, lastmodified=?
+                       WHERE accountid=? AND issuer=? AND assetcode=?""",
+                    (
+                        atype,
+                        tl.limit,
+                        tl.balance,
+                        tl.flags,
+                        self.last_modified,
+                        _aid(tl.accountID),
+                        issuer,
+                        code,
+                    ),
+                )
+
+    def store_add(self, delta, db) -> None:
+        self._stamp(delta)
+        self._persist(db, insert=True)
+        delta.add_entry(self)
+        self.store_in_cache(db, self.get_key(), self.entry)
+
+    def store_change(self, delta, db) -> None:
+        self._stamp(delta)
+        self._persist(db, insert=False)
+        delta.mod_entry(self)
+        self.store_in_cache(db, self.get_key(), self.entry)
+
+    def store_delete(self, delta, db) -> None:
+        tl = self.trust_line
+        _, issuer, code = asset_to_cols(tl.asset)
+        with db.timed("delete", "trust"):
+            db.execute(
+                "DELETE FROM trustlines WHERE accountid=? AND issuer=? AND assetcode=?",
+                (_aid(tl.accountID), issuer, code),
+            )
+        delta.delete_entry_frame(self)
+        self.store_in_cache(db, self.get_key(), None)

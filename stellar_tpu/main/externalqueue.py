@@ -1,0 +1,70 @@
+"""ExternalQueue: pubsub cursors gating maintenance deletion
+(reference: src/main/ExternalQueue.*).
+
+External consumers (a Horizon-alike) register a cursor; ``maintenance`` may
+only delete tx history at/below the minimum cursor.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+
+class ExternalQueue:
+    _VALID = re.compile(r"^[A-Z][A-Z0-9]{0,31}$")
+
+    def __init__(self, app_or_db):
+        self._db = getattr(app_or_db, "database", app_or_db)
+
+    @staticmethod
+    def drop_all(db) -> None:
+        db.execute("DROP TABLE IF EXISTS pubsub")
+        db.execute(
+            """CREATE TABLE pubsub (
+                resid    CHARACTER(32) PRIMARY KEY,
+                lastread INTEGER
+            )"""
+        )
+
+    @classmethod
+    def validate_resource_id(cls, resid: str) -> bool:
+        return bool(cls._VALID.match(resid))
+
+    def set_cursor_for_resource(self, resid: str, cursor: int) -> None:
+        if not self.validate_resource_id(resid):
+            raise ValueError(f"invalid resource id {resid!r}")
+        self._db.execute(
+            "INSERT INTO pubsub (resid, lastread) VALUES (?,?) "
+            "ON CONFLICT(resid) DO UPDATE SET lastread=excluded.lastread",
+            (resid, cursor),
+        )
+
+    def get_cursor_for_resource(self, resid: str) -> Optional[int]:
+        row = self._db.query_one(
+            "SELECT lastread FROM pubsub WHERE resid=?", (resid,)
+        )
+        return row[0] if row else None
+
+    def delete_cursor(self, resid: str) -> None:
+        self._db.execute("DELETE FROM pubsub WHERE resid=?", (resid,))
+
+    def min_cursor(self) -> Optional[int]:
+        row = self._db.query_one("SELECT MIN(lastread) FROM pubsub")
+        return row[0] if row and row[0] is not None else None
+
+    def delete_old_entries(self, count: int) -> None:
+        """Trim tx history at/below the min cursor (maintenance endpoint)."""
+        m = self.min_cursor()
+        if m is None:
+            return
+        self._db.execute(
+            "DELETE FROM txhistory WHERE ledgerseq <= ? AND ledgerseq IN "
+            "(SELECT DISTINCT ledgerseq FROM txhistory ORDER BY ledgerseq LIMIT ?)",
+            (m, count),
+        )
+        self._db.execute(
+            "DELETE FROM txfeehistory WHERE ledgerseq <= ? AND ledgerseq IN "
+            "(SELECT DISTINCT ledgerseq FROM txfeehistory ORDER BY ledgerseq LIMIT ?)",
+            (m, count),
+        )

@@ -1,0 +1,260 @@
+"""Metrics registry (reference: lib/libmedida + per-subsystem NewMeter/NewTimer
+call sites, SURVEY.md §5.5).
+
+Same shapes as medida: Counter, Meter (count + EWMA 1/5/15min rates), Histogram
+(reservoir percentiles), Timer (histogram-of-durations + meter).  Reported as
+JSON with medida's field names so the admin ``/metrics`` endpoint looks like
+the reference's (main/CommandHandler.cpp:82).
+
+Metric names are dotted triples like ``scp.envelope.sign``.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+import time
+from typing import Dict, Optional
+
+
+class Counter:
+    def __init__(self):
+        self.count = 0
+
+    def inc(self, n: int = 1):
+        self.count += n
+
+    def dec(self, n: int = 1):
+        self.count -= n
+
+    def set_count(self, n: int):
+        self.count = n
+
+    def to_json(self):
+        return {"type": "counter", "count": self.count}
+
+
+class EWMA:
+    """Exponentially-weighted moving average rate, medida-style (5s ticks)."""
+
+    TICK_SECONDS = 5.0
+
+    def __init__(self, minutes: float, clock=None):
+        self._alpha = 1.0 - math.exp(-self.TICK_SECONDS / 60.0 / minutes)
+        self._uncounted = 0
+        self._rate = 0.0
+        self._initialized = False
+
+    def update(self, n: int = 1):
+        self._uncounted += n
+
+    def tick(self):
+        instant = self._uncounted / self.TICK_SECONDS
+        self._uncounted = 0
+        if self._initialized:
+            self._rate += self._alpha * (instant - self._rate)
+        else:
+            self._rate = instant
+            self._initialized = True
+
+    @property
+    def rate(self) -> float:
+        return self._rate
+
+
+class Meter:
+    def __init__(self, event_type: str = "event", clock=None):
+        self.event_type = event_type
+        self.count = 0
+        self._clock = clock
+        self._start = self._now()
+        self._last_tick = self._start
+        self._m1 = EWMA(1)
+        self._m5 = EWMA(5)
+        self._m15 = EWMA(15)
+
+    def _now(self) -> float:
+        return self._clock.now() if self._clock is not None else time.monotonic()
+
+    def mark(self, n: int = 1):
+        self._tick_if_needed()
+        self.count += n
+        self._m1.update(n)
+        self._m5.update(n)
+        self._m15.update(n)
+
+    def _tick_if_needed(self):
+        now = self._now()
+        while now - self._last_tick >= EWMA.TICK_SECONDS:
+            self._m1.tick()
+            self._m5.tick()
+            self._m15.tick()
+            self._last_tick += EWMA.TICK_SECONDS
+
+    @property
+    def mean_rate(self) -> float:
+        elapsed = self._now() - self._start
+        return self.count / elapsed if elapsed > 0 else 0.0
+
+    @property
+    def one_minute_rate(self) -> float:
+        self._tick_if_needed()
+        return self._m1.rate
+
+    def to_json(self):
+        self._tick_if_needed()
+        return {
+            "type": "meter",
+            "count": self.count,
+            "event_type": self.event_type,
+            "mean_rate": self.mean_rate,
+            "1_min_rate": self._m1.rate,
+            "5_min_rate": self._m5.rate,
+            "15_min_rate": self._m15.rate,
+        }
+
+
+class Histogram:
+    """Uniform reservoir sample (medida's default), size 1028."""
+
+    RESERVOIR = 1028
+
+    def __init__(self, rng: Optional[random.Random] = None):
+        self.count = 0
+        self._sum = 0.0
+        self._min = None
+        self._max = None
+        self._sample = []
+        self._rng = rng or random.Random(0x5EED)
+
+    def update(self, value: float):
+        self.count += 1
+        self._sum += value
+        self._min = value if self._min is None else min(self._min, value)
+        self._max = value if self._max is None else max(self._max, value)
+        if len(self._sample) < self.RESERVOIR:
+            self._sample.append(value)
+        else:
+            i = self._rng.randrange(self.count)
+            if i < self.RESERVOIR:
+                self._sample[i] = value
+
+    def percentile(self, q: float) -> float:
+        if not self._sample:
+            return 0.0
+        s = sorted(self._sample)
+        pos = q * (len(s) - 1)
+        lo = int(math.floor(pos))
+        hi = min(lo + 1, len(s) - 1)
+        frac = pos - lo
+        return s[lo] * (1 - frac) + s[hi] * frac
+
+    @property
+    def mean(self) -> float:
+        return self._sum / self.count if self.count else 0.0
+
+    def to_json(self):
+        return {
+            "type": "histogram",
+            "count": self.count,
+            "min": self._min or 0.0,
+            "max": self._max or 0.0,
+            "mean": self.mean,
+            "median": self.percentile(0.5),
+            "75%": self.percentile(0.75),
+            "95%": self.percentile(0.95),
+            "98%": self.percentile(0.98),
+            "99%": self.percentile(0.99),
+            "99.9%": self.percentile(0.999),
+        }
+
+
+class Timer:
+    """Duration metric; values recorded in milliseconds like medida."""
+
+    def __init__(self, clock=None):
+        self._clock = clock
+        self.histogram = Histogram()
+        self.meter = Meter("calls", clock)
+
+    def update(self, seconds: float):
+        self.histogram.update(seconds * 1000.0)
+        self.meter.mark()
+
+    def time_scope(self) -> "TimeScope":
+        return TimeScope(self)
+
+    @property
+    def count(self):
+        return self.histogram.count
+
+    def to_json(self):
+        j = self.histogram.to_json()
+        j.update(
+            {
+                "type": "timer",
+                "duration_unit": "milliseconds",
+                "rate_unit": "calls/s",
+                "mean_rate": self.meter.mean_rate,
+                "1_min_rate": self.meter.one_minute_rate,
+            }
+        )
+        return j
+
+
+class TimeScope:
+    def __init__(self, timer: Timer):
+        self._timer = timer
+        self._t0 = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._timer.update(time.perf_counter() - self._t0)
+        return False
+
+
+class MetricsRegistry:
+    """Per-Application registry (main/Application.h:168)."""
+
+    def __init__(self, clock=None):
+        self._clock = clock
+        self._metrics: Dict[str, object] = {}
+
+    def _get(self, name, factory, want_type):
+        m = self._metrics.get(name)
+        if m is None:
+            m = factory()
+            self._metrics[name] = m
+        elif not isinstance(m, want_type):
+            # medida asserts on metric-type collisions; so do we
+            raise TypeError(
+                f"metric {name!r} is {type(m).__name__}, not {want_type.__name__}"
+            )
+        return m
+
+    @staticmethod
+    def _name(parts) -> str:
+        return ".".join(parts) if not isinstance(parts, str) else parts
+
+    def new_counter(self, parts) -> Counter:
+        return self._get(self._name(parts), Counter, Counter)
+
+    def new_meter(self, parts, event_type: str = "event") -> Meter:
+        return self._get(
+            self._name(parts), lambda: Meter(event_type, self._clock), Meter
+        )
+
+    def new_histogram(self, parts) -> Histogram:
+        return self._get(self._name(parts), Histogram, Histogram)
+
+    def new_timer(self, parts) -> Timer:
+        return self._get(self._name(parts), lambda: Timer(self._clock), Timer)
+
+    def get(self, parts):
+        return self._metrics.get(self._name(parts))
+
+    def to_json(self) -> dict:
+        return {name: m.to_json() for name, m in sorted(self._metrics.items())}

@@ -1,0 +1,52 @@
+"""TmpDir / TmpDirManager / fs helpers (reference: src/util/TmpDir.*, Fs.*)."""
+
+from __future__ import annotations
+
+import os
+import shutil
+import uuid
+
+
+def deltree(path: str) -> None:
+    shutil.rmtree(path, ignore_errors=True)
+
+
+def mkpath(path: str) -> None:
+    os.makedirs(path, exist_ok=True)
+
+
+def exists(path: str) -> bool:
+    return os.path.exists(path)
+
+
+class TmpDir:
+    def __init__(self, path: str):
+        self._path = path
+        mkpath(path)
+
+    def get_name(self) -> str:
+        return self._path
+
+    def __fspath__(self):
+        return self._path
+
+
+class TmpDirManager:
+    """Owns a root dir of per-purpose temp subdirs, cleaned on forget/exit."""
+
+    def __init__(self, root: str):
+        self._root = root
+        self.clean()
+        mkpath(root)
+
+    def tmp_dir(self, prefix: str) -> TmpDir:
+        return TmpDir(os.path.join(self._root, f"{prefix}-{uuid.uuid4().hex[:12]}"))
+
+    def forget(self, d: TmpDir) -> None:
+        deltree(d.get_name())
+
+    def clean(self) -> None:
+        deltree(self._root)
+
+    def get_root(self) -> str:
+        return self._root

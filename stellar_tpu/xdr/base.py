@@ -469,34 +469,41 @@ def xunion(switch_codec, arms: Dict[Any, Optional[XdrCodec]], default_void=False
     return deco
 
 
+import threading as _threading
+
+
 class DepthLimited(XdrCodec):
     """Bounds recursion for self-referential types (e.g. SCPQuorumSet), so a
-    crafted wire message deepens into XdrError instead of RecursionError."""
+    crafted wire message deepens into XdrError instead of RecursionError.
+    Depth is tracked per-thread: decodes on worker threads don't interfere."""
 
     def __init__(self, inner: Optional[XdrCodec] = None, max_depth: int = 8):
         self.inner = inner
         self.max_depth = max_depth
-        self._depth = 0
+        self._tls = _threading.local()
 
     def _enter(self):
-        self._depth += 1
-        if self._depth > self.max_depth:
-            self._depth -= 1
+        depth = getattr(self._tls, "depth", 0) + 1
+        if depth > self.max_depth:
             raise XdrError(f"recursion deeper than {self.max_depth}")
+        self._tls.depth = depth
+
+    def _exit(self):
+        self._tls.depth -= 1
 
     def pack_into(self, val, out):
         self._enter()
         try:
             self.inner.pack_into(val, out)
         finally:
-            self._depth -= 1
+            self._exit()
 
     def unpack_from(self, buf, off):
         self._enter()
         try:
             return self.inner.unpack_from(buf, off)
         finally:
-            self._depth -= 1
+            self._exit()
 
 
 def codec_of(obj_or_cls) -> XdrCodec:

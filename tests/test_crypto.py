@@ -1,0 +1,197 @@
+"""Crypto tests (shaped like the reference's crypto/CryptoTests.cpp:
+sign/verify round trips, strkey round trips, HMAC/HKDF vectors, hex).
+"""
+
+import pytest
+from hypothesis import given, strategies as st
+
+from stellar_tpu.crypto import (
+    PubKeyUtils,
+    SecretKey,
+    hkdf_expand,
+    hkdf_extract,
+    hmac_sha256,
+    hmac_sha256_verify,
+    make_backend,
+    sha256,
+    verify_cache,
+)
+from stellar_tpu.crypto import ecdh, strkey
+from stellar_tpu.xdr.xtypes import PublicKey
+
+
+class TestSha:
+    def test_sha256_vector(self):
+        # FIPS 180-2 vector
+        assert (
+            sha256(b"abc").hex()
+            == "ba7816bf8f01cfea414140de5dae2223b00361a396177a9cb410ff61f20015ad"
+        )
+
+    def test_hmac_rfc4231_case2(self):
+        key = b"Jefe"
+        data = b"what do ya want for nothing?"
+        assert hmac_sha256(key, data).hex() == (
+            "5bdcc146bf60754e6a042426089575c75a003f089d2739839dec58b964ec3843"
+        )
+
+    def test_hmac_verify(self):
+        mac = hmac_sha256(b"k" * 32, b"hello")
+        assert hmac_sha256_verify(mac, b"k" * 32, b"hello")
+        assert not hmac_sha256_verify(mac, b"k" * 32, b"hellp")
+        assert not hmac_sha256_verify(b"\x00" * 32, b"k" * 32, b"hello")
+
+    def test_hkdf_matches_reference_construction(self):
+        """Reference HKDF is literally HMAC(zero,x) / HMAC(k,x|0x01)
+        (SHA.cpp:105-135)."""
+        data = b"shared secret material"
+        assert hkdf_extract(data) == hmac_sha256(b"\x00" * 32, data)
+        k = hkdf_extract(data)
+        assert hkdf_expand(k, b"info") == hmac_sha256(k, b"info\x01")
+
+
+class TestStrKey:
+    def test_crc16_xmodem_vector(self):
+        # standard XModem check value for "123456789"
+        assert strkey.crc16(b"123456789") == 0x31C3
+
+    def test_roundtrip_account(self):
+        pk = bytes(range(32))
+        s = strkey.to_account_strkey(pk)
+        assert s.startswith("G")
+        assert len(s) == 56
+        assert strkey.from_account_strkey(s) == pk
+
+    def test_roundtrip_seed(self):
+        seed = bytes(reversed(range(32)))
+        s = strkey.to_seed_strkey(seed)
+        assert s.startswith("S")
+        assert strkey.from_seed_strkey(s) == seed
+
+    def test_corruption_detected(self):
+        s = strkey.to_account_strkey(b"\x07" * 32)
+        corrupted = ("A" if s[10] != "A" else "B").join([s[:10], s[11:]])
+        with pytest.raises(ValueError):
+            strkey.from_account_strkey(corrupted)
+
+    def test_wrong_version_rejected(self):
+        s = strkey.to_seed_strkey(b"\x07" * 32)
+        with pytest.raises(ValueError):
+            strkey.from_account_strkey(s)
+
+    @given(st.binary(min_size=32, max_size=32))
+    def test_roundtrip_property(self, payload):
+        assert strkey.from_account_strkey(strkey.to_account_strkey(payload)) == payload
+
+
+class TestKeys:
+    def test_sign_verify_roundtrip(self):
+        sk = SecretKey.pseudo_random_for_testing(1)
+        msg = b"hello consensus"
+        sig = sk.sign(msg)
+        assert len(sig) == 64
+        assert PubKeyUtils.verify_sig(sk.get_public_key(), sig, msg)
+        assert not PubKeyUtils.verify_sig(sk.get_public_key(), sig, msg + b"!")
+
+    def test_rfc8032_test_vector_1(self):
+        """RFC 8032 §7.1 TEST 1: empty message."""
+        seed = bytes.fromhex(
+            "9d61b19deffd5a60ba844af492ec2cc44449c5697b326919703bac031cae7f60"
+        )
+        sk = SecretKey.from_seed(seed)
+        assert (
+            sk.public_raw.hex()
+            == "d75a980182b10ab7d54bfed3c964073a0ee172f3daa62325af021a68f707511a"
+        )
+        sig = sk.sign(b"")
+        assert sig.hex() == (
+            "e5564300c360ac729086e2cc806e828a84877f1eb8e5d974d873e06522490155"
+            "5fb8821590a33bacc61e39701cf9b46bd25bf5f0595bbe24655141438e7a100b"
+        )
+        assert PubKeyUtils.verify_sig(sk.get_public_key(), sig, b"")
+
+    def test_cross_check_with_cryptography_lib(self):
+        """Independent implementation agreement (OpenSSL vs libsodium)."""
+        from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+            Ed25519PrivateKey,
+        )
+
+        seed = sha256(b"cross-check")
+        ours = SecretKey.from_seed(seed)
+        theirs = Ed25519PrivateKey.from_private_bytes(seed)
+        assert ours.public_raw == theirs.public_key().public_bytes_raw()
+        msg = b"message"
+        assert ours.sign(msg) == theirs.sign(msg)
+
+    def test_strkey_seed_roundtrip(self):
+        sk = SecretKey.pseudo_random_for_testing(7)
+        s = sk.get_strkey_seed()
+        assert SecretKey.from_strkey_seed(s).public_raw == sk.public_raw
+
+    def test_hint(self):
+        pk = PublicKey.from_ed25519(bytes(range(32)))
+        assert PubKeyUtils.get_hint(pk) == bytes([28, 29, 30, 31])
+        assert PubKeyUtils.has_hint(pk, bytes([28, 29, 30, 31]))
+        assert not PubKeyUtils.has_hint(pk, b"\x00\x00\x00\x00")
+
+
+class TestVerifyCache:
+    def test_cache_hit_counting(self):
+        sk = SecretKey.pseudo_random_for_testing(2)
+        msg = b"cache me"
+        sig = sk.sign(msg)
+        PubKeyUtils.clear_verify_sig_cache()
+        PubKeyUtils.flush_verify_sig_cache_counts()
+        assert PubKeyUtils.verify_sig(sk.get_public_key(), sig, msg)
+        assert PubKeyUtils.verify_sig(sk.get_public_key(), sig, msg)
+        hits, misses = PubKeyUtils.flush_verify_sig_cache_counts()
+        assert misses == 1
+        assert hits == 1
+
+    def test_negative_results_cached_too(self):
+        sk = SecretKey.pseudo_random_for_testing(3)
+        bad_sig = b"\x01" * 64
+        PubKeyUtils.clear_verify_sig_cache()
+        assert not PubKeyUtils.verify_sig(sk.get_public_key(), bad_sig, b"m")
+        assert not PubKeyUtils.verify_sig(sk.get_public_key(), bad_sig, b"m")
+        hits, misses = PubKeyUtils.flush_verify_sig_cache_counts()
+        assert (hits, misses) == (1, 1)
+
+
+class TestSigBackendCpu:
+    def test_batch_verify_mixed(self):
+        backend = make_backend("cpu")
+        keys = [SecretKey.pseudo_random_for_testing(i) for i in range(8)]
+        items = []
+        expected = []
+        for i, sk in enumerate(keys):
+            msg = b"tx %d" % i
+            sig = sk.sign(msg)
+            if i % 3 == 0:
+                sig = sig[:-1] + bytes([sig[-1] ^ 1])  # corrupt
+                expected.append(False)
+            else:
+                expected.append(True)
+            items.append((sk.public_raw, msg, sig))
+        verify_cache().clear()
+        assert backend.verify_batch(items) == expected
+        # second run: all from cache
+        verify_cache().flush_counts()
+        assert backend.verify_batch(items) == expected
+        hits, misses = verify_cache().flush_counts()
+        assert hits == 8 and misses == 0
+
+
+class TestEcdh:
+    def test_shared_key_agreement(self):
+        a_sec = ecdh.ecdh_random_secret()
+        b_sec = ecdh.ecdh_random_secret()
+        a_pub = ecdh.ecdh_derive_public(a_sec)
+        b_pub = ecdh.ecdh_derive_public(b_sec)
+        # A called first; B answered
+        k_ab = ecdh.ecdh_derive_shared_key(a_sec, a_pub, b_pub, local_first=True)
+        k_ba = ecdh.ecdh_derive_shared_key(b_sec, b_pub, a_pub, local_first=False)
+        assert k_ab == k_ba
+        # ordering matters: both-first disagrees
+        k_bad = ecdh.ecdh_derive_shared_key(b_sec, b_pub, a_pub, local_first=True)
+        assert k_ab != k_bad
