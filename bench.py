@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Headline benchmark: batched ed25519 verification throughput on TPU.
+
+Measures end-to-end verifies/sec through TpuSigBackend's BatchVerifier —
+including the host strict-input gate, SHA-512 reduction, array staging, and
+device compute — on distinct keys/messages/signatures (worst case for the
+verify cache, which is bypassed here).
+
+Baseline (BASELINE.md): ≥200,000 verifies/sec/chip on v5e-1, and ≥10× a
+single libsodium core (measured live below).  vs_baseline reported against
+the 200k/s target.
+
+Prints exactly ONE JSON line.
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def bench_libsodium_single_core(items, seconds=1.0):
+    from stellar_tpu.crypto import sodium
+
+    t0 = time.perf_counter()
+    n = 0
+    while time.perf_counter() - t0 < seconds:
+        pk, msg, sig = items[n % len(items)]
+        sodium.verify_detached(sig, msg, pk)
+        n += 1
+    return n / (time.perf_counter() - t0)
+
+
+def main():
+    batch = int(os.environ.get("BENCH_BATCH", "8192"))
+    iters = int(os.environ.get("BENCH_ITERS", "5"))
+
+    from stellar_tpu.crypto import SecretKey
+    from stellar_tpu.ops.ed25519 import BatchVerifier
+
+    # distinct key/message/signature triples
+    items = []
+    for i in range(batch):
+        sk = SecretKey.pseudo_random_for_testing(i)
+        msg = b"bench message %08d" % i
+        items.append((sk.public_raw, msg, sk.sign(msg)))
+
+    cpu_rate = bench_libsodium_single_core(items, seconds=1.0)
+
+    bv = BatchVerifier(max_batch=batch, min_device_batch=batch)
+    # warmup + compile
+    out = bv.verify(items)
+    assert all(out), "benchmark signatures must all verify"
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = bv.verify(items)
+    dt = time.perf_counter() - t0
+    assert all(out)
+    rate = batch * iters / dt
+
+    result = {
+        "metric": "ed25519_verifies_per_sec",
+        "value": round(rate, 1),
+        "unit": "verifies/sec",
+        "vs_baseline": round(rate / 200_000.0, 3),
+        "batch": batch,
+        "iters": iters,
+        "libsodium_single_core_per_sec": round(cpu_rate, 1),
+        "speedup_vs_libsodium_core": round(rate / cpu_rate, 2),
+        "device": _device_kind(),
+    }
+    print(json.dumps(result))
+
+
+def _device_kind():
+    try:
+        import jax
+
+        return str(jax.devices()[0])
+    except Exception as e:  # pragma: no cover
+        return f"unknown ({e})"
+
+
+if __name__ == "__main__":
+    main()

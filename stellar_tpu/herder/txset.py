@@ -1,0 +1,208 @@
+"""TxSetFrame (reference: src/herder/TxSetFrame.{h,cpp}).
+
+Canonical form: transactions sorted by full hash; contents hash =
+SHA256(previousLedgerHash ‖ envelopes-in-hash-order).  Apply order re-sorts
+per account by sequence number with hash-XOR randomized interleave.
+
+**Batch-verify hot spot** (SURVEY.md §2.2): ``check_valid``/``trim_invalid``
+first collect every hint-matched (pubkey, contentsHash, sig) candidate across
+the whole set and flush them through the app's SigBackend (TPU or CPU) into
+the shared verify cache — one device round-trip for the entire set — then run
+the reference's exact eager algorithm, which now hits only cache.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..crypto import SHA256
+from ..tx.frame import TransactionFrame
+from ..xdr.ledger import TransactionSet
+from ..xdr.xtypes import PublicKey
+
+
+def less_than_xored(l: bytes, r: bytes, x: bytes) -> bool:
+    """util/types.cpp lessThanXored."""
+    v1 = bytes(a ^ b for a, b in zip(x, l))
+    v2 = bytes(a ^ b for a, b in zip(x, r))
+    return v1 < v2
+
+
+class TxSetFrame:
+    def __init__(self, previous_ledger_hash: bytes, transactions=None):
+        self.previous_ledger_hash = previous_ledger_hash
+        self.transactions: List[TransactionFrame] = list(transactions or [])
+        self._hash: Optional[bytes] = None
+
+    @classmethod
+    def from_xdr_set(cls, network_id: bytes, xdr_set: TransactionSet) -> "TxSetFrame":
+        txs = [
+            TransactionFrame.make_from_wire(network_id, env) for env in xdr_set.txs
+        ]
+        return cls(xdr_set.previousLedgerHash, txs)
+
+    # -- canonical ordering & hash -----------------------------------------
+    def sort_for_hash(self) -> None:
+        self.transactions.sort(key=lambda tx: tx.get_full_hash())
+        self._hash = None
+
+    def get_contents_hash(self) -> bytes:
+        if self._hash is None:
+            self.sort_for_hash()
+            h = SHA256()
+            h.add(self.previous_ledger_hash)
+            for tx in self.transactions:
+                h.add(tx.envelope.to_xdr())
+            self._hash = h.finish()
+        return self._hash
+
+    def add_transaction(self, tx: TransactionFrame) -> None:
+        self.transactions.append(tx)
+        self._hash = None
+
+    def remove_tx(self, tx: TransactionFrame) -> None:
+        try:
+            self.transactions.remove(tx)
+        except ValueError:
+            pass
+        self._hash = None
+
+    def size(self) -> int:
+        return len(self.transactions)
+
+    def to_xdr(self) -> TransactionSet:
+        self.sort_for_hash()
+        return TransactionSet(
+            self.previous_ledger_hash, [tx.envelope for tx in self.transactions]
+        )
+
+    # -- apply order (TxSetFrame.cpp:93-131) -------------------------------
+    def sort_for_apply(self) -> List[TransactionFrame]:
+        txs = sorted(self.transactions, key=lambda tx: tx.get_seq_num())
+        batches: List[List[TransactionFrame]] = [[] for _ in range(4)]
+        seen_count: Dict[bytes, int] = {}
+        for tx in txs:
+            v = seen_count.get(tx.get_source_id().value, 0)
+            if v >= len(batches):
+                batches.extend([] for _ in range(4))
+            batches[v].append(tx)
+            seen_count[tx.get_source_id().value] = v + 1
+
+        set_hash = self.get_contents_hash()
+        import functools
+
+        cmp = functools.cmp_to_key(
+            lambda t1, t2: -1
+            if less_than_xored(t1.get_full_hash(), t2.get_full_hash(), set_hash)
+            else 1
+        )
+        out: List[TransactionFrame] = []
+        for batch in batches:
+            batch.sort(key=cmp)
+            out.extend(batch)
+        return out
+
+    # -- shared validity core ----------------------------------------------
+    def _prewarm_signature_cache(self, app) -> None:
+        """One SigBackend batch for the entire set (the TPU flush point)."""
+        backend = getattr(app, "sig_backend", None)
+        if backend is None:
+            return
+        triples = []
+        for tx in self.transactions:
+            triples.extend(tx.candidate_signature_pairs(app.database))
+        if triples:
+            backend.verify_batch(triples)
+
+    def _account_tx_map(self) -> Dict[bytes, List[TransactionFrame]]:
+        m: Dict[bytes, List[TransactionFrame]] = {}
+        for tx in self.transactions:
+            m.setdefault(tx.get_source_id().value, []).append(tx)
+        return m
+
+    @staticmethod
+    def _check_account_chain(app, txs: List[TransactionFrame]):
+        """Per-account: seq chain valid + can afford total fees.
+        Returns (ok, invalid_txs)."""
+        txs.sort(key=lambda t: t.get_seq_num())
+        invalid = []
+        last_tx = None
+        last_seq = 0
+        tot_fee = 0
+        for tx in txs:
+            if not tx.check_valid(app, last_seq):
+                invalid.append(tx)
+                continue
+            tot_fee += tx.get_fee()
+            last_tx = tx
+            last_seq = tx.get_seq_num()
+        if last_tx is not None:
+            acct = last_tx.signing_account
+            if acct.get_balance() - tot_fee < acct.get_minimum_balance(
+                app.ledger_manager
+            ):
+                return False, txs  # whole account group is bad
+        return True, invalid
+
+    def check_valid(self, app) -> bool:
+        """TxSetFrame.cpp:247-330."""
+        lcl = app.ledger_manager.get_last_closed_ledger_header()
+        if lcl.hash != self.previous_ledger_hash:
+            return False
+        if len(self.transactions) > lcl.header.maxTxSetSize:
+            return False
+
+        last_hash = b"\x00" * 32
+        for tx in self.transactions:
+            if tx.get_full_hash() < last_hash:
+                return False  # not in canonical order
+            last_hash = tx.get_full_hash()
+
+        self._prewarm_signature_cache(app)
+
+        for txs in self._account_tx_map().values():
+            ok, invalid = self._check_account_chain(app, list(txs))
+            if not ok or invalid:
+                return False
+        return True
+
+    def trim_invalid(self, app) -> List[TransactionFrame]:
+        """Remove invalid txs; returns the trimmed ones (TxSetFrame.cpp:190)."""
+        self.sort_for_hash()
+        self._prewarm_signature_cache(app)
+        trimmed: List[TransactionFrame] = []
+        for txs in self._account_tx_map().values():
+            ok, invalid = self._check_account_chain(app, list(txs))
+            if not ok:
+                for tx in txs:
+                    trimmed.append(tx)
+                    self.remove_tx(tx)
+            else:
+                for tx in invalid:
+                    trimmed.append(tx)
+                    self.remove_tx(tx)
+        return trimmed
+
+    # -- surge pricing (TxSetFrame.cpp:156-186) ----------------------------
+    def surge_pricing_filter(self, lm) -> None:
+        max_size = lm.get_max_tx_set_size()
+        if len(self.transactions) <= max_size:
+            return
+        account_fee: Dict[bytes, float] = {}
+        for tx in self.transactions:
+            r = tx.get_fee() / tx.get_min_fee(lm)
+            cur = account_fee.get(tx.get_source_id().value, 0.0)
+            if cur == 0 or r < cur:
+                account_fee[tx.get_source_id().value] = r
+
+        def surge_key(tx):
+            # higher fee ratio first; ties by account id; within an account by seq
+            return (
+                -account_fee[tx.get_source_id().value],
+                tx.get_source_id().value,
+                tx.get_seq_num(),
+            )
+
+        ordered = sorted(self.transactions, key=surge_key)
+        for tx in ordered[max_size:]:
+            self.remove_tx(tx)

@@ -1,0 +1,56 @@
+"""HistoryManager (reference: src/history/HistoryManagerImpl.cpp).
+
+INTERIM shell: checkpoint cadence constants + crash-safe queue wiring; the
+publish/catchup state machines land in publishsm.py / catchupsm.py.
+"""
+
+from __future__ import annotations
+
+from ..util import xlog
+from . import publish as publish_queue
+
+log = xlog.logger("History")
+
+CHECKPOINT_FREQUENCY = 64  # ledgers (~5 min; HistoryManagerImpl.cpp:230)
+
+
+def checkpoint_containing_ledger(ledger: int) -> int:
+    """First checkpoint ledger >= ledger (boundaries at 63, 127, ...)."""
+    return ((ledger // CHECKPOINT_FREQUENCY) + 1) * CHECKPOINT_FREQUENCY - 1
+
+
+class HistoryManager:
+    def __init__(self, app):
+        self.app = app
+        self.publishing = False
+
+    @property
+    def has_archives(self) -> bool:
+        return bool(self.app.config.HISTORY)
+
+    def next_checkpoint_ledger(self, ledger: int) -> int:
+        return checkpoint_containing_ledger(ledger)
+
+    def maybe_queue_history_checkpoint(self) -> None:
+        # called after ledger pointers advanced: the just-closed ledger is LCL.
+        # Checkpoints close at seqs 63, 127, ... (HistoryManagerImpl queues
+        # when the NEXT ledger number is a multiple of the frequency).
+        closed_seq = self.app.ledger_manager.last_closed.header.ledgerSeq
+        if (closed_seq + 1) % CHECKPOINT_FREQUENCY != 0:
+            return
+        if not self.has_archives:
+            return
+        publish_queue.queue_checkpoint(
+            self.app.database, closed_seq,
+            self.app.bucket_manager.archive_state_json(closed_seq),
+        )
+        log.info("queued checkpoint at ledger %d", closed_seq)
+
+    def publish_queued_history(self) -> None:
+        if not self.has_archives or self.publishing:
+            return
+        # full publish state machine lands in history/publishsm.py
+
+    def catchup_history(self, init_ledger: int, mode: str, done_cb) -> None:
+        # full catchup state machine lands in history/catchupsm.py
+        raise NotImplementedError("catchup state machine not wired yet")

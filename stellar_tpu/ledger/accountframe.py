@@ -117,6 +117,28 @@ class AccountFrame(EntryFrame):
         self.account.numSubEntries = new_count
         return True
 
+    @classmethod
+    def make_auth_only(cls, account_id: PublicKey) -> "AccountFrame":
+        """Signature-check-only shell for not-yet-existing op sources during
+        validation (AccountFrame::makeAuthOnlyAccount): negative balance trips
+        any attempt to persist it (the accounts CHECK constraint)."""
+        f = cls(account_id=account_id)
+        f.account.balance = -0x8000000000000000
+        return f
+
+    @staticmethod
+    def process_for_inflation(db, max_winners: int):
+        """[(votes, inflation_dest_pk)] — vote tally grouped by inflationdest,
+        min 100 XLM balance to vote (AccountFrame::processForInflation)."""
+        rows = db.query_all(
+            "SELECT sum(balance) AS votes, inflationdest FROM accounts"
+            " WHERE inflationdest IS NOT NULL AND balance >= 1000000000"
+            " GROUP BY inflationdest ORDER BY votes DESC, inflationdest DESC"
+            " LIMIT ?",
+            (max_winners,),
+        )
+        return [(votes, _from_aid(dest)) for votes, dest in rows]
+
     # -- SQL ---------------------------------------------------------------
     @staticmethod
     def drop_all(db) -> None:

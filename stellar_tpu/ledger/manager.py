@@ -1,0 +1,284 @@
+"""LedgerManager (reference: src/ledger/LedgerManagerImpl.{h,cpp}).
+
+Closes ledgers (the system's "train step", SURVEY.md §3.2), tracks the
+last-closed-ledger header chain, drives catchup on gaps, owns genesis.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+from ..crypto import sha256
+from ..crypto.keys import SecretKey
+from ..util import xlog
+from ..xdr.base import XdrError
+from ..xdr.ledger import (
+    LedgerHeader,
+    LedgerUpgrade,
+    LedgerUpgradeType,
+    TransactionResultSet,
+    UPGRADE_TYPE,
+)
+from ..xdr.ledger import TransactionMeta
+from .accountframe import AccountFrame
+from .delta import LedgerDelta
+from .headerframe import LedgerHeaderFrame
+
+log = xlog.logger("Ledger")
+
+GENESIS_BALANCE = 1000000000000000000  # 10^18 stroops
+
+
+class LedgerState(enum.Enum):
+    LM_BOOTING_STATE = 0
+    LM_SYNCED_STATE = 1
+    LM_CATCHING_UP_STATE = 2
+
+
+@dataclass
+class LastClosedLedger:
+    hash: bytes
+    header: LedgerHeader
+
+
+class LedgerManager:
+    def __init__(self, app):
+        self.app = app
+        self.database = app.database
+        self.state = LedgerState.LM_BOOTING_STATE
+        self.current: Optional[LedgerHeaderFrame] = None
+        self.last_closed: Optional[LastClosedLedger] = None
+        self._close_timer = app.metrics.new_timer(("ledger", "ledger", "close"))
+        self._tx_apply_timer = app.metrics.new_timer(
+            ("ledger", "transaction", "apply")
+        )
+        self._tx_count_meter = app.metrics.new_meter(
+            ("ledger", "transaction", "count"), "tx"
+        )
+        # catchup buffering (LedgerManagerImpl.cpp:321-408)
+        self.syncing_ledgers: List = []
+
+    # -- parameters --------------------------------------------------------
+    def get_tx_fee(self) -> int:
+        return self.current.header.baseFee
+
+    def get_min_balance(self, owner_count: int) -> int:
+        return (2 + owner_count) * self.current.header.baseReserve
+
+    def get_max_tx_set_size(self) -> int:
+        return self.current.header.maxTxSetSize
+
+    def get_ledger_num(self) -> int:
+        return self.current.header.ledgerSeq
+
+    def get_last_closed_ledger_num(self) -> int:
+        return self.last_closed.header.ledgerSeq
+
+    def get_close_time(self) -> int:
+        return self.current.header.scpValue.closeTime
+
+    def get_current_ledger_header(self) -> LedgerHeader:
+        return self.current.header
+
+    def get_last_closed_ledger_header(self) -> LastClosedLedger:
+        return self.last_closed
+
+    def is_synced(self) -> bool:
+        return self.state == LedgerState.LM_SYNCED_STATE
+
+    # -- boot (LedgerManagerImpl.cpp:154-240) ------------------------------
+    def start_new_ledger(self) -> None:
+        """Genesis: master account funded with all coins, ledger 1."""
+        skey = SecretKey.from_seed(self.app.network_id)
+        master = AccountFrame(account_id=skey.get_public_key())
+        master.account.balance = GENESIS_BALANCE
+
+        genesis = LedgerHeader(
+            ledgerVersion=0,
+            ledgerSeq=1,
+            baseFee=100,
+            baseReserve=100000000,
+            maxTxSetSize=100,
+            totalCoins=GENESIS_BALANCE,
+        )
+        self.current = LedgerHeaderFrame(genesis)
+        with self.database.transaction():
+            delta = LedgerDelta(genesis, self.database)
+            master.store_add(delta, self.database)
+            delta.commit()
+            log.info(
+                "Established genesis ledger; root account %s",
+                skey.get_strkey_public(),
+            )
+            self._close_ledger_helper(delta)
+        self.state = LedgerState.LM_SYNCED_STATE
+
+    def load_last_known_ledger(self) -> None:
+        from ..main.persistentstate import K_LAST_CLOSED_LEDGER, PersistentState
+
+        last = PersistentState(self.database).get_state(K_LAST_CLOSED_LEDGER)
+        if not last:
+            raise RuntimeError("No ledger in the DB")
+        frame = LedgerHeaderFrame.load_by_hash(self.database, bytes.fromhex(last))
+        if frame is None:
+            raise RuntimeError("Could not load ledger from database")
+        self.current = frame
+        self._advance_ledger_pointers()
+        self.state = LedgerState.LM_SYNCED_STATE
+
+    # -- externalize path (LedgerManagerImpl.cpp:321-408) ------------------
+    def externalize_value(self, ledger_data) -> None:
+        if ledger_data.ledger_seq == self.last_closed.header.ledgerSeq + 1:
+            self.close_ledger(ledger_data)
+            self.app.herder_notify_ledger_closed()
+        elif ledger_data.ledger_seq <= self.last_closed.header.ledgerSeq:
+            log.debug("skipping old ledger %d", ledger_data.ledger_seq)
+        else:
+            # gap: buffer and catch up (SURVEY §3.4)
+            log.info(
+                "gap detected: have %d got %d — buffering + catchup",
+                self.last_closed.header.ledgerSeq,
+                ledger_data.ledger_seq,
+            )
+            self.syncing_ledgers.append(ledger_data)
+            self.start_catchup()
+
+    def start_catchup(self) -> None:
+        self.state = LedgerState.LM_CATCHING_UP_STATE
+        self.app.request_catchup()
+
+    def history_caught_up(self) -> None:
+        """Replay any buffered ledgers then flip to synced."""
+        for ld in sorted(self.syncing_ledgers, key=lambda l: l.ledger_seq):
+            if ld.ledger_seq == self.last_closed.header.ledgerSeq + 1:
+                self.close_ledger(ld)
+        self.syncing_ledgers.clear()
+        self.state = LedgerState.LM_SYNCED_STATE
+        self.app.herder_notify_ledger_closed()
+
+    # -- THE close (LedgerManagerImpl.cpp:612-741) -------------------------
+    def close_ledger(self, ledger_data) -> None:
+        if ledger_data.tx_set.previous_ledger_hash != self.last_closed.hash:
+            raise RuntimeError("txset mismatch: wrong previous ledger hash")
+        if ledger_data.tx_set.get_contents_hash() != ledger_data.value.txSetHash:
+            raise RuntimeError("corrupt transaction set")
+
+        with self._close_timer.time_scope(), self.database.transaction():
+            sv = ledger_data.value
+            self.current.header.scpValue = sv
+            self.current.invalidate_hash()
+            ledger_delta = LedgerDelta(self.current.header, self.database)
+
+            txs = ledger_data.tx_set.sort_for_apply()
+            # pre-warm the verify cache for the whole set in one batch —
+            # at apply time every signature check is a cache hit
+            ledger_data.tx_set._prewarm_signature_cache(self.app)
+
+            self._process_fees_seq_nums(txs, ledger_delta)
+
+            tx_result_set = TransactionResultSet([])
+            self._apply_transactions(txs, ledger_delta, tx_result_set)
+            ledger_delta.header.txSetResultHash = sha256(tx_result_set.to_xdr())
+
+            # consensus upgrades apply after the txset (validated before)
+            for raw in sv.upgrades:
+                up = LedgerUpgrade.from_xdr(raw)
+                h = ledger_delta.header
+                if up.type == LedgerUpgradeType.LEDGER_UPGRADE_VERSION:
+                    h.ledgerVersion = up.value
+                elif up.type == LedgerUpgradeType.LEDGER_UPGRADE_BASE_FEE:
+                    h.baseFee = up.value
+                elif up.type == LedgerUpgradeType.LEDGER_UPGRADE_MAX_TX_SET_SIZE:
+                    h.maxTxSetSize = up.value
+                else:
+                    raise RuntimeError(f"Unknown upgrade type {up.type}")
+
+            if self.app.config.PARANOID_MODE:
+                ledger_delta.check_against_database(self.database)
+
+            ledger_delta.commit()
+            self.current.invalidate_hash()
+            self._close_ledger_helper(ledger_delta)
+
+            # queue any checkpoint inside this SQL transaction (crash-safe)
+            self.app.history_manager.maybe_queue_history_checkpoint()
+
+        # outside the transaction: kick publishing + bucket GC
+        self.app.history_manager.publish_queued_history()
+        self.app.bucket_manager.forget_unreferenced_buckets()
+
+    def _process_fees_seq_nums(self, txs, delta) -> None:
+        with self.database.transaction():
+            for index, tx in enumerate(txs, start=1):
+                this_tx_delta = LedgerDelta(outer=delta)
+                tx.process_fee_seq_num(this_tx_delta, self)
+                tx.store_transaction_fee(
+                    self.database,
+                    self.current.header.ledgerSeq,
+                    index,
+                    this_tx_delta.get_changes(),
+                )
+                this_tx_delta.commit()
+
+    def _apply_transactions(self, txs, ledger_delta, tx_result_set) -> None:
+        from ..xdr.txs import TransactionResultCode
+
+        for index, tx in enumerate(txs, start=1):
+            with self._tx_apply_timer.time_scope():
+                delta = LedgerDelta(outer=ledger_delta)
+                meta = TransactionMeta(0, [])
+                try:
+                    if tx.apply(delta, self.app, meta):
+                        delta.commit()
+                    else:
+                        assert not delta.get_changes()
+                except Exception as e:  # tx must never take down the close
+                    log.error("exception during tx apply: %s", e)
+                    tx.set_result_code(TransactionResultCode.txINTERNAL_ERROR)
+            self._tx_count_meter.mark()
+            tx_result_set.results.append(tx.get_result_pair())
+            tx.store_transaction(
+                self.database, self.current.header.ledgerSeq, index, meta
+            )
+
+    def _close_ledger_helper(self, delta) -> None:
+        """BucketList add + header store + LCL pointers
+        (LedgerManagerImpl.cpp:891-...)."""
+        from ..main.persistentstate import (
+            K_HISTORY_ARCHIVE_STATE,
+            K_LAST_CLOSED_LEDGER,
+            PersistentState,
+        )
+
+        self.app.bucket_manager.add_batch(
+            self.current.header.ledgerSeq,
+            delta.get_live_entries(),
+            delta.get_dead_entries(),
+        )
+        self.current.header.bucketListHash = self.app.bucket_manager.get_hash()
+        self.current.invalidate_hash()
+        self.current.store_insert(self.database)
+        ps = PersistentState(self.database)
+        ps.set_state(K_LAST_CLOSED_LEDGER, self.current.get_hash().hex())
+        ps.set_state(
+            K_HISTORY_ARCHIVE_STATE, self.app.bucket_manager.archive_state_json(
+                self.current.header.ledgerSeq
+            )
+        )
+        self._advance_ledger_pointers()
+
+    def _advance_ledger_pointers(self) -> None:
+        self.last_closed = LastClosedLedger(
+            self.current.get_hash(),
+            LedgerHeader.from_xdr(self.current.header.to_xdr()),
+        )
+        self.current = LedgerHeaderFrame.from_previous(self.current)
+
+    @staticmethod
+    def delete_old_entries(db, ledger_seq: int) -> None:
+        from ..tx import history as tx_history
+
+        LedgerHeaderFrame.delete_old_entries(db, ledger_seq)
+        tx_history.delete_old_entries(db, ledger_seq)

@@ -46,11 +46,15 @@ def asset_from_cols(atype: int, issuer: Optional[str], code: Optional[str]) -> A
     return Asset.alphanum12(raw, issuer_pk)
 
 
+from ..util.xmath import INT64_MAX
+
+
 class TrustFrame(EntryFrame):
     entry_type = LedgerEntryType.TRUSTLINE
 
-    def __init__(self, entry: LedgerEntry):
+    def __init__(self, entry: LedgerEntry, is_issuer: bool = False):
         self.trust_line: TrustLineEntry = entry.data.value
+        self.is_issuer = is_issuer
         super().__init__(entry)
 
     @classmethod
@@ -59,6 +63,24 @@ class TrustFrame(EntryFrame):
             accountID=account_id, asset=asset, balance=0, limit=0, flags=0, ext=0
         )
         return cls(LedgerEntry(0, LedgerEntryData(LedgerEntryType.TRUSTLINE, tl), 0))
+
+    @classmethod
+    def make_issuer_frame(cls, asset: Asset) -> "TrustFrame":
+        """Synthetic authorized line for the asset's issuer: infinite balance
+        and limit, never persisted (TrustFrame::createIssuerFrame)."""
+        issuer = asset.code_and_issuer()[1]
+        tl = TrustLineEntry(
+            accountID=issuer,
+            asset=asset,
+            balance=INT64_MAX,
+            limit=INT64_MAX,
+            flags=int(TrustLineFlags.AUTHORIZED_FLAG),
+            ext=0,
+        )
+        return cls(
+            LedgerEntry(0, LedgerEntryData(LedgerEntryType.TRUSTLINE, tl), 0),
+            is_issuer=True,
+        )
 
     def _compute_key(self) -> LedgerKey:
         return LedgerKey(
@@ -71,18 +93,27 @@ class TrustFrame(EntryFrame):
         return self.trust_line.balance
 
     def add_balance(self, delta: int) -> bool:
-        if self.trust_line.accountID == self.trust_line.asset.code_and_issuer()[1]:
-            return True  # issuer's own line is a no-op (TrustFrame.cpp issuer check)
-        new = self.trust_line.balance + delta
-        if new < 0 or new > self.trust_line.limit:
+        """TrustFrame::addBalance: issuer lines absorb anything; otherwise
+        requires authorization and respects [0, limit]."""
+        if self.is_issuer:
+            return True
+        if delta == 0:
+            return True
+        if not self.is_authorized():
             return False
-        self.trust_line.balance = new
+        if self.trust_line.limit < delta + self.trust_line.balance:
+            return False
+        if self.trust_line.balance + delta < 0:
+            return False
+        self.trust_line.balance += delta
         return True
 
     def get_max_amount_receive(self) -> int:
-        if self.trust_line.accountID == self.trust_line.asset.code_and_issuer()[1]:
-            return 0x7FFFFFFFFFFFFFFF  # issuer can absorb anything
-        return self.trust_line.limit - self.trust_line.balance
+        if self.is_issuer:
+            return INT64_MAX
+        if self.is_authorized():
+            return self.trust_line.limit - self.trust_line.balance
+        return 0
 
     def is_authorized(self) -> bool:
         return bool(self.trust_line.flags & TrustLineFlags.AUTHORIZED_FLAG)
@@ -115,6 +146,10 @@ class TrustFrame(EntryFrame):
     def load_trust_line(
         cls, account_id: PublicKey, asset: Asset, db
     ) -> Optional["TrustFrame"]:
+        if asset.is_native():
+            raise ValueError("no trustlines for the native asset")
+        if account_id == asset.code_and_issuer()[1]:
+            return cls.make_issuer_frame(asset)
         key = LedgerKey(
             LedgerEntryType.TRUSTLINE, LedgerKeyTrustLine(account_id, asset)
         )
@@ -186,19 +221,32 @@ class TrustFrame(EntryFrame):
                     ),
                 )
 
+    @classmethod
+    def load_trust_line_issuer(cls, account_id: PublicKey, asset: Asset, db):
+        """(trustline, issuer_account) pair (TrustFrame::loadTrustLineIssuer)."""
+        from .accountframe import AccountFrame
+
+        line = cls.load_trust_line(account_id, asset, db)
+        issuer = AccountFrame.load_account(asset.code_and_issuer()[1], db)
+        return line, issuer
+
     def store_add(self, delta, db) -> None:
+        assert not self.is_issuer, "issuer frames are never persisted"
         self._stamp(delta)
         self._persist(db, insert=True)
         delta.add_entry(self)
         self.store_in_cache(db, self.get_key(), self.entry)
 
     def store_change(self, delta, db) -> None:
+        if self.is_issuer:
+            return  # synthetic line: nothing to persist
         self._stamp(delta)
         self._persist(db, insert=False)
         delta.mod_entry(self)
         self.store_in_cache(db, self.get_key(), self.entry)
 
     def store_delete(self, delta, db) -> None:
+        assert not self.is_issuer
         tl = self.trust_line
         _, issuer, code = asset_to_cols(tl.asset)
         with db.timed("delete", "trust"):

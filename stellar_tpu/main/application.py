@@ -1,0 +1,136 @@
+"""Application — the composition root (reference: src/main/ApplicationImpl.cpp).
+
+Owns one VirtualClock slice, the database, and every manager; subsystems find
+each other only through this object, which is what lets the simulation run
+many Applications in one process on one clock (SURVEY.md §2.11).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..bucket.manager import BucketManager
+from ..crypto import make_backend, sha256
+from ..database.database import Database
+from ..history.manager import HistoryManager
+from ..ledger.manager import LedgerManager
+from ..util import MetricsRegistry, TmpDirManager, VirtualClock, xlog
+from .config import Config
+from .persistentstate import (
+    K_DATABASE_INITIALIZED,
+    K_FORCE_SCP_ON_NEXT_LAUNCH,
+    PersistentState,
+)
+
+log = xlog.logger("Ledger")
+
+
+class AppState:
+    BOOTING = "Booting"
+    CONNECTED = "Connected standby"
+    ACQUIRING_CONSENSUS = "Joining SCP"
+    CATCHING_UP = "Catching up"
+    SYNCED = "Synced!"
+
+
+class Application:
+    def __init__(self, clock: VirtualClock, config: Config, new_db: bool = False):
+        self.clock = clock
+        self.config = config
+        if not config.NETWORK_PASSPHRASE:
+            raise ValueError("NETWORK_PASSPHRASE not configured")
+        self.network_id = sha256(config.NETWORK_PASSPHRASE.encode())
+        self.metrics = MetricsRegistry(clock)
+        self.database = Database(config.DATABASE, self.metrics)
+        self.persistent_state = PersistentState(self.database)
+        self.tmp_dirs = TmpDirManager(config.TMP_DIR_PATH)
+        # the SIGNATURE_BACKEND knob: every batch verify in the node flows
+        # through this object (and the shared verify cache)
+        self.sig_backend = make_backend(
+            config.SIGNATURE_BACKEND, max_batch=config.SIG_BATCH_MAX
+        )
+        self.bucket_manager = BucketManager(self)
+        self.ledger_manager = LedgerManager(self)
+        self.history_manager = HistoryManager(self)
+        self.herder = None  # attached by create() once built
+        self.overlay_manager = None
+        self.command_handler = None
+        self.process_manager = None
+
+        if new_db or self._needs_initialization():
+            self.initialize_db()
+
+    # -- creation ----------------------------------------------------------
+    @classmethod
+    def create(cls, clock: VirtualClock, config: Config, new_db: bool = False):
+        app = cls(clock, config, new_db=new_db)
+        from ..herder.herder import Herder
+        from ..overlay.manager import OverlayManager
+        from ..process.manager import ProcessManager
+        from .commandhandler import CommandHandler
+
+        app.process_manager = ProcessManager(app)
+        app.overlay_manager = OverlayManager(app)
+        app.herder = Herder(app)
+        app.command_handler = CommandHandler(app)
+        return app
+
+    def _needs_initialization(self) -> bool:
+        try:
+            return self.persistent_state.get_state(K_DATABASE_INITIALIZED) != "true"
+        except Exception:
+            return True
+
+    def initialize_db(self) -> None:
+        self.database.initialize()
+        self.persistent_state.set_state(K_DATABASE_INITIALIZED, "true")
+        self.ledger_manager.start_new_ledger()
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        """Load LCL, start overlay, maybe force SCP (ApplicationImpl::start)."""
+        if self.persistent_state.get_state(K_DATABASE_INITIALIZED) == "true":
+            if self.ledger_manager.last_closed is None:
+                self.ledger_manager.load_last_known_ledger()
+        force = (
+            self.config.FORCE_SCP
+            or self.persistent_state.get_state(K_FORCE_SCP_ON_NEXT_LAUNCH) == "true"
+        )
+        if self.herder is not None:
+            if force:
+                self.herder.bootstrap()
+            else:
+                self.herder.restore_scp_state()
+        if self.overlay_manager is not None and not self.config.RUN_STANDALONE:
+            self.overlay_manager.start()
+        if self.command_handler is not None:
+            self.command_handler.start()
+
+    def graceful_stop(self) -> None:
+        if self.overlay_manager is not None:
+            self.overlay_manager.shutdown()
+        if self.command_handler is not None:
+            self.command_handler.stop()
+        self.database.close()
+
+    # -- cross-subsystem notifications -------------------------------------
+    def herder_notify_ledger_closed(self) -> None:
+        if self.herder is not None:
+            self.herder.ledger_closed()
+
+    def request_catchup(self) -> None:
+        if self.herder is not None:
+            self.herder.lost_sync()
+        # catchup FSM started by the herder/history integration
+
+    def get_state(self) -> str:
+        lm = self.ledger_manager
+        from ..ledger.manager import LedgerState
+
+        if lm.last_closed is None:
+            return AppState.BOOTING
+        if lm.state == LedgerState.LM_CATCHING_UP_STATE:
+            return AppState.CATCHING_UP
+        if lm.state == LedgerState.LM_SYNCED_STATE:
+            return AppState.SYNCED
+        return AppState.CONNECTED

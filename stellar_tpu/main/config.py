@@ -1,0 +1,123 @@
+"""Config (reference: src/main/Config.{h,cpp} via cpptoml; here: tomllib).
+
+Same knob set plus the framework's own ``SIGNATURE_BACKEND = "cpu"|"tpu"``
+(the north-star selector from BASELINE.json — the reference hardwires
+libsodium; we route every verify through the chosen SigBackend).
+"""
+
+from __future__ import annotations
+
+import tomllib
+from typing import Dict, List, Optional
+
+from ..crypto.keys import PubKeyUtils, SecretKey
+from ..xdr.scp import SCPQuorumSet
+from ..xdr.xtypes import PublicKey
+
+
+class Config:
+    def __init__(self):
+        # process / node
+        self.FORCE_SCP = False
+        self.REBUILD_DB = False
+        self.RUN_STANDALONE = False
+        self.MANUAL_CLOSE = False
+        self.CATCHUP_COMPLETE = False
+        self.ARTIFICIALLY_GENERATE_LOAD_FOR_TESTING = False
+        self.ARTIFICIALLY_ACCELERATE_TIME_FOR_TESTING = False
+        self.ARTIFICIALLY_PESSIMIZE_MERGES_FOR_TESTING = False
+        self.ALLOW_LOCALHOST_FOR_TESTING = False
+        self.FAILURE_SAFETY = 1
+        self.UNSAFE_QUORUM = False
+        self.LEDGER_PROTOCOL_VERSION = 1
+        self.OVERLAY_PROTOCOL_MIN_VERSION = 1
+        self.OVERLAY_PROTOCOL_VERSION = 2
+        self.VERSION_STR = "stellar-tpu 0.1.0"
+        self.LOG_FILE_PATH = ""
+        self.TMP_DIR_PATH = "tmp"
+        self.BUCKET_DIR_PATH = "buckets"
+        self.DESIRED_BASE_FEE = 100
+        self.DESIRED_BASE_RESERVE = 100000000
+        self.DESIRED_MAX_TX_PER_LEDGER = 500
+        self.HTTP_PORT = 39132
+        self.PUBLIC_HTTP_PORT = False
+        self.NETWORK_PASSPHRASE = ""
+        # overlay
+        self.PEER_PORT = 39133
+        self.TARGET_PEER_CONNECTIONS = 20
+        self.MAX_PEER_CONNECTIONS = 50
+        self.PREFERRED_PEERS: List[str] = []
+        self.KNOWN_PEERS: List[str] = []
+        self.PREFERRED_PEER_KEYS: List[str] = []
+        self.PREFERRED_PEERS_ONLY = False
+        self.MAX_CONCURRENT_SUBPROCESSES = 16
+        self.MINIMUM_IDLE_PERCENT = 0
+        self.PARANOID_MODE = False
+        # identity / consensus
+        self.NODE_SEED: Optional[SecretKey] = None
+        self.NODE_IS_VALIDATOR = False
+        self.QUORUM_SET = SCPQuorumSet(0, [], [])
+        self.VALIDATOR_NAMES: Dict[str, str] = {}
+        # history
+        self.HISTORY: Dict[str, dict] = {}
+        # storage
+        self.DATABASE = "sqlite3://:memory:"
+        self.COMMANDS: List[str] = []
+        self.REPORT_METRICS: List[str] = []
+        # TPU-native addition: which SigBackend serves batch verifies
+        self.SIGNATURE_BACKEND = "cpu"
+        self.SIG_BATCH_MAX = 4096
+
+    # -- loading -----------------------------------------------------------
+    @classmethod
+    def load(cls, path: str) -> "Config":
+        with open(path, "rb") as f:
+            data = tomllib.load(f)
+        return cls.from_dict(data)
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "Config":
+        cfg = cls()
+        simple = {
+            k
+            for k in vars(cfg)
+            if k.isupper() and k not in ("NODE_SEED", "QUORUM_SET", "HISTORY")
+        }
+        for key, value in data.items():
+            if key == "NODE_SEED":
+                cfg.NODE_SEED = SecretKey.from_strkey_seed(str(value).split()[0])
+            elif key == "QUORUM_SET":
+                cfg.QUORUM_SET = cls._parse_qset(value)
+            elif key == "HISTORY":
+                cfg.HISTORY = dict(value)
+            elif key in simple:
+                setattr(cfg, key, value)
+            # unknown keys are ignored like cpptoml does for sections
+        cfg.validate()
+        return cfg
+
+    @classmethod
+    def _parse_qset(cls, spec: dict, level: int = 0) -> SCPQuorumSet:
+        """[QUORUM_SET] THRESHOLD=N VALIDATORS=[strkeys...] + nested
+        [QUORUM_SET.N] inner sets (Config.cpp loadQset; 2 levels max)."""
+        if level > 2:
+            raise ValueError("QUORUM_SET nesting deeper than 2")
+        qs = SCPQuorumSet(int(spec.get("THRESHOLD", 0)), [], [])
+        for v in spec.get("VALIDATORS", []):
+            qs.validators.append(PubKeyUtils.from_strkey(str(v).split()[0]))
+        for key, sub in spec.items():
+            if isinstance(sub, dict):
+                qs.innerSets.append(cls._parse_qset(sub, level + 1))
+        return qs
+
+    def validate(self) -> None:
+        if self.QUORUM_SET.threshold == 0 and (
+            self.QUORUM_SET.validators or self.QUORUM_SET.innerSets
+        ):
+            raise ValueError("QUORUM_SET threshold must be > 0")
+        if self.SIGNATURE_BACKEND not in ("cpu", "tpu"):
+            raise ValueError(f"bad SIGNATURE_BACKEND {self.SIGNATURE_BACKEND!r}")
+
+    def to_short_string(self, pk: PublicKey) -> str:
+        s = PubKeyUtils.to_strkey(pk)
+        return self.VALIDATOR_NAMES.get(s, s[:5])

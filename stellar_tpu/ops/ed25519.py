@@ -1,0 +1,365 @@
+"""Batched ed25519 verification on TPU (JAX).
+
+The split (SURVEY.md §7 hard-part #1, BASELINE.json north star):
+
+- **host**: libsodium's strict input gate (canonical s, canonical A, small-
+  order A/R rejection — byte compares, see ops/ref25519.strict_input_ok),
+  SHA-512(R‖A‖M) mod L (hashlib), scalar→nibble splitting (numpy);
+- **device**: point decompress of A (field exponentiation), Straus
+  double-scalar multiplication R' = s·B + h·(−A) with 4-bit windows
+  (shared doublings, niels tables, complete a=−1 twisted Edwards formulas),
+  point encoding, byte compare against R.
+
+Verification semantics are bit-exact with libsodium
+``crypto_sign_verify_detached`` (differential suite: tests/test_ed25519_tpu.py).
+
+Curve math dataflow is pure int32; batch axis N rides the TPU vector lanes
+(layout notes in ops/fe.py).  One compile per padded batch size.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+from functools import partial
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import fe
+from . import ref25519 as ref
+
+D = ref.D
+D2 = (2 * ref.D) % ref.P
+SQRT_M1 = ref.SQRT_M1
+L = ref.L
+
+_D_FE = fe.const_fe(D)
+_D2_FE = fe.const_fe(D2)
+_SQRT_M1_FE = fe.const_fe(SQRT_M1)
+
+WINDOWS = 64  # 4-bit windows over 256-bit scalars
+
+
+# ---------------------------------------------------------------------------
+# point ops — extended coordinates (X:Y:Z:T), a=-1 complete formulas
+# ---------------------------------------------------------------------------
+
+
+def point_identity(n, dtype=jnp.int32):
+    zero = jnp.zeros((fe.LIMBS, n), dtype)
+    one = zero.at[0].set(1)
+    return (zero, one, one, zero)
+
+
+def point_add(p, q):
+    """General extended + extended (add-2008-hwcd-3 shape, 9M)."""
+    X1, Y1, Z1, T1 = p
+    X2, Y2, Z2, T2 = q
+    a = fe.mul(fe.sub(Y1, X1), fe.sub(Y2, X2))
+    b = fe.mul(fe.add(Y1, X1), fe.add(Y2, X2))
+    c = fe.mul(fe.mul(T1, T2), _D2_FE)
+    d = fe.mul_small(fe.mul(Z1, Z2), 2)
+    e = fe.sub(b, a)
+    f = fe.sub(d, c)
+    g = fe.add(d, c)
+    h = fe.add(b, a)
+    return (fe.mul(e, f), fe.mul(g, h), fe.mul(f, g), fe.mul(e, h))
+
+
+def point_add_niels(p, n):
+    """Extended + precomputed niels (YpX, YmX, T2d, Z2): 8M."""
+    X1, Y1, Z1, T1 = p
+    YpX2, YmX2, T2d2, Z22 = n
+    a = fe.mul(fe.sub(Y1, X1), YmX2)
+    b = fe.mul(fe.add(Y1, X1), YpX2)
+    c = fe.mul(T1, T2d2)
+    d = fe.mul(Z1, Z22)
+    e = fe.sub(b, a)
+    f = fe.sub(d, c)
+    g = fe.add(d, c)
+    h = fe.add(b, a)
+    return (fe.mul(e, f), fe.mul(g, h), fe.mul(f, g), fe.mul(e, h))
+
+
+def point_double(p):
+    """dbl-2008-hwcd with a=-1: 4S + 4M."""
+    X1, Y1, Z1, _ = p
+    a = fe.sqr(X1)
+    b = fe.sqr(Y1)
+    c = fe.mul_small(fe.sqr(Z1), 2)
+    d = fe.neg(a)  # a_coef = -1
+    e = fe.sub(fe.sub(fe.sqr(fe.add(X1, Y1)), a), b)
+    g = fe.add(d, b)
+    f = fe.sub(g, c)
+    h = fe.sub(d, b)
+    return (fe.mul(e, f), fe.mul(g, h), fe.mul(f, g), fe.mul(e, h))
+
+
+def to_niels(p):
+    X, Y, Z, T = p
+    return (
+        fe.add(Y, X),
+        fe.sub(Y, X),
+        fe.mul(T, _D2_FE),
+        fe.mul_small(Z, 2),
+    )
+
+
+def point_negate(p):
+    X, Y, Z, T = p
+    return (fe.neg(X), Y, Z, fe.neg(T))
+
+
+def compress(p):
+    """-> ((32, N) bytes, x-parity already folded into byte 31)."""
+    X, Y, Z, _ = p
+    zinv = fe.inv(Z)
+    x = fe.mul(X, zinv)
+    y = fe.mul(Y, zinv)
+    by = fe.bytes_from_limbs(fe.canonical(y))
+    sign = fe.parity(x)
+    by = by.at[31].add(sign << 7)
+    return by
+
+
+def decompress(y_limbs, sign):
+    """-> (point, fail) matching ref25519.decompress for canonical y."""
+    one = jnp.zeros_like(y_limbs).at[0].set(1)
+    yy = fe.sqr(y_limbs)
+    u = fe.sub(yy, one)
+    v = fe.add(fe.mul(yy, _D_FE), one)
+    v3 = fe.mul(fe.sqr(v), v)
+    v7 = fe.mul(fe.sqr(v3), v)
+    x = fe.mul(fe.mul(u, v3), fe.pow_p58(fe.mul(u, v7)))
+    vxx = fe.mul(v, fe.sqr(x))
+    ok1 = fe.eq(vxx, u)
+    ok2 = fe.eq(vxx, fe.neg(u))
+    x = fe.select(ok2, fe.mul(x, _SQRT_M1_FE), x)
+    fail = ~(ok1 | ok2)
+    fail = fail | (fe.is_zero(x) & (sign == 1))
+    flip = fe.parity(x) != sign
+    x = fe.select(flip, fe.neg(x), x)
+    return (x, y_limbs, one, fe.mul(x, y_limbs)), fail
+
+
+# ---------------------------------------------------------------------------
+# fixed-base table (host-precomputed from the reference implementation)
+# ---------------------------------------------------------------------------
+
+
+def _base_niels_table_np() -> np.ndarray:
+    """(4, 16, 20) int32: niels components of k*B for k=0..15."""
+    tab = np.zeros((4, 16, fe.LIMBS), dtype=np.int32)
+    pt = ref.IDENT
+    B = ref.base_point()
+    for k in range(16):
+        x, y, z, t = pt
+        zinv = ref.fe_inv(z)
+        xa, ya = x * zinv % ref.P, y * zinv % ref.P
+        ta = xa * ya % ref.P
+        tab[0, k] = fe.int_to_limbs((ya + xa) % ref.P)
+        tab[1, k] = fe.int_to_limbs((ya - xa) % ref.P)
+        tab[2, k] = fe.int_to_limbs(ta * D2 % ref.P)
+        tab[3, k] = fe.int_to_limbs(2)
+        pt = ref.point_add(pt, B)
+    return tab
+
+
+_BASE_TABLE = jnp.asarray(_base_niels_table_np())  # (4, 16, 20)
+
+
+def _select_base(nib):
+    """nib (N,) -> niels tuple of (20, N) from the static base table."""
+    onehot = (nib[None, :] == jnp.arange(16, dtype=nib.dtype)[:, None]).astype(
+        jnp.int32
+    )  # (16, N)
+    comps = jnp.einsum("kn,ckl->cln", onehot, _BASE_TABLE)  # (4, 20, N)
+    return (comps[0], comps[1], comps[2], comps[3])
+
+
+def _select_dyn(table, nib):
+    """table: tuple of 4 arrays (20, 16, N); nib (N,)."""
+    onehot = (nib[None, :] == jnp.arange(16, dtype=nib.dtype)[:, None]).astype(
+        jnp.int32
+    )  # (16, N)
+    return tuple(jnp.einsum("kn,lkn->ln", onehot, t) for t in table)
+
+
+def _build_a_table(neg_a):
+    """niels table of k*(-A) for k=0..15: tuple of 4 arrays (20, 16, N).
+
+    Sequential adds run under lax.scan (15 iterations, one traced body);
+    the niels conversion is then vectorized across all 16 entries at once —
+    fe ops are shape-polymorphic in the trailing dims.
+    """
+    n = neg_a[0].shape[1]
+
+    def step(p, _):
+        p2 = point_add(p, neg_a)
+        return p2, p2
+
+    _, mults = jax.lax.scan(step, point_identity(n), None, length=15)
+    # mults: 4 arrays (15, 20, N); prepend identity and move limbs first
+    ident = point_identity(n)
+    full = tuple(
+        jnp.concatenate([ident[c][None], mults[c]], axis=0).transpose(1, 0, 2)
+        for c in range(4)
+    )  # (20, 16, N)
+    return to_niels(full)
+
+
+# ---------------------------------------------------------------------------
+# the verify kernel
+# ---------------------------------------------------------------------------
+
+
+def verify_kernel(a_y_limbs, a_sign, r_bytes, s_nibs, h_nibs):
+    """All-device batched check R' == R.
+
+    a_y_limbs (20,N) — y limbs of A (sign already stripped)
+    a_sign    (N,)   — sign bit of A's encoding
+    r_bytes   (32,N) — signature R bytes (to compare against)
+    s_nibs    (64,N) — s scalar nibbles, little-endian
+    h_nibs    (64,N) — h = SHA512(R‖A‖M) mod L nibbles, little-endian
+    returns   (N,) bool
+    """
+    a_pt, fail = decompress(a_y_limbs, a_sign)
+    neg_a = point_negate(a_pt)
+    a_table = _build_a_table(neg_a)
+
+    n = a_y_limbs.shape[1]
+
+    def body(i, acc):
+        t = WINDOWS - 1 - i
+        for _ in range(4):
+            acc = point_double(acc)
+        acc = point_add_niels(acc, _select_base(s_nibs[t]))
+        acc = point_add_niels(acc, _select_dyn(a_table, h_nibs[t]))
+        return acc
+
+    acc = jax.lax.fori_loop(0, WINDOWS, body, point_identity(n))
+    enc = compress(acc)
+    match = jnp.all(enc == r_bytes, axis=0)
+    return match & ~fail
+
+
+# ---------------------------------------------------------------------------
+# host orchestration
+# ---------------------------------------------------------------------------
+
+
+def _nibbles_np(scalars_le_bytes: np.ndarray) -> np.ndarray:
+    """(N, 32) uint8 -> (64, N) int32 nibbles little-endian."""
+    lo = scalars_le_bytes & 0x0F
+    hi = scalars_le_bytes >> 4
+    inter = np.empty((scalars_le_bytes.shape[0], 64), dtype=np.int32)
+    inter[:, 0::2] = lo
+    inter[:, 1::2] = hi
+    return np.ascontiguousarray(inter.T)
+
+
+class BatchVerifier:
+    """Pads batches to pow-2 buckets (one XLA compile per bucket), runs the
+    kernel, scatters results; host gate failures never reach the device."""
+
+    def __init__(self, max_batch: int = 4096, mesh=None, min_device_batch: int = 16):
+        self.max_batch = max_batch
+        self.min_device_batch = min_device_batch
+        self.mesh = mesh
+        self._kernel = self._make_kernel()
+        self.n_device_calls = 0
+        self.n_items = 0
+        self.n_gate_rejects = 0
+        self.device_seconds = 0.0
+
+    def _make_kernel(self):
+        kern = verify_kernel
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as PSpec
+
+            batch_axis = self.mesh.axis_names[0]
+            shard = NamedSharding(self.mesh, PSpec(None, batch_axis))
+            vec = NamedSharding(self.mesh, PSpec(batch_axis))
+            kern = jax.jit(
+                verify_kernel,
+                in_shardings=(shard, vec, shard, shard, shard),
+                out_shardings=vec,
+            )
+            return kern
+        return jax.jit(kern)
+
+    def _bucket(self, n: int) -> int:
+        b = self.min_device_batch
+        while b < n:
+            b *= 2
+        if self.mesh is not None:
+            b = max(b, len(self.mesh.devices.flat))
+        return min(b, self.max_batch) if n <= self.max_batch else self.max_batch
+
+    def verify(self, items: Sequence[Tuple[bytes, bytes, bytes]]) -> List[bool]:
+        """items: (pubkey32, msg, sig64) triples -> list of bool."""
+        out = [False] * len(items)
+        todo = []  # (orig_idx, pk, msg, sig)
+        for i, (pk, msg, sig) in enumerate(items):
+            if ref.strict_input_ok(pk, sig):
+                todo.append((i, pk, msg, sig))
+            else:
+                self.n_gate_rejects += 1
+        self.n_items += len(items)
+        for start in range(0, len(todo), self.max_batch):
+            chunk = todo[start : start + self.max_batch]
+            results = self._run_chunk(chunk)
+            for (i, *_), ok in zip(chunk, results):
+                out[i] = bool(ok)
+        return out
+
+    def _run_chunk(self, chunk) -> np.ndarray:
+        n = len(chunk)
+        if n == 0:
+            return np.zeros(0, dtype=bool)
+        bucket = self._bucket(n)
+        a_bytes = np.zeros((bucket, 32), dtype=np.uint8)
+        r_bytes = np.zeros((bucket, 32), dtype=np.uint8)
+        s_bytes = np.zeros((bucket, 32), dtype=np.uint8)
+        h_bytes = np.zeros((bucket, 32), dtype=np.uint8)
+        for j, (_, pk, msg, sig) in enumerate(chunk):
+            a_bytes[j] = np.frombuffer(pk, dtype=np.uint8)
+            r_bytes[j] = np.frombuffer(sig[:32], dtype=np.uint8)
+            s_bytes[j] = np.frombuffer(sig[32:], dtype=np.uint8)
+            h = (
+                int.from_bytes(
+                    hashlib.sha512(sig[:32] + pk + msg).digest(), "little"
+                )
+                % L
+            )
+            h_bytes[j] = np.frombuffer(h.to_bytes(32, "little"), dtype=np.uint8)
+        sign = (a_bytes[:, 31] >> 7).astype(np.int32)
+        a_masked = a_bytes.copy()
+        a_masked[:, 31] &= 0x7F
+        a_cols = np.ascontiguousarray(a_masked.T).astype(np.int32)  # (32, B)
+        y_limbs = fe.limbs_from_bytes(jnp.asarray(a_cols))
+        t0 = time.perf_counter()
+        ok = self._kernel(
+            y_limbs,
+            jnp.asarray(sign),
+            jnp.asarray(np.ascontiguousarray(r_bytes.T).astype(np.int32)),
+            jnp.asarray(_nibbles_np(s_bytes)),
+            jnp.asarray(_nibbles_np(h_bytes)),
+        )
+        ok = np.asarray(ok)
+        self.device_seconds += time.perf_counter() - t0
+        self.n_device_calls += 1
+        return ok[:n]
+
+    def stats(self) -> dict:
+        return {
+            "backend": "tpu",
+            "device_calls": self.n_device_calls,
+            "items": self.n_items,
+            "gate_rejects": self.n_gate_rejects,
+            "device_seconds": self.device_seconds,
+        }

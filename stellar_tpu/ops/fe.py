@@ -1,0 +1,239 @@
+"""Batched GF(2^255-19) arithmetic for TPU, in JAX.
+
+Design (SURVEY.md §7 hard-part #1): TPU VPUs have no 64-bit integer multiply,
+so field elements use **radix 2^13 with 20 int32 limbs**, batch-last layout
+``(20, N)`` (N rides the 8x128 vector lanes; the limb axis stays on sublanes).
+Bounds that make int32 safe throughout:
+
+- weakly-reduced elements have limbs < 2^13, value < 2^255 + ε
+- schoolbook products: ≤ 20 terms × (2^13-1)² < 2^31          (no overflow)
+- 2^260 ≡ 608 (mod p) folds the high 19 limbs back with ≤ 2^23 additions
+
+Everything is shape-polymorphic in N and differentiably irrelevant — pure
+integer ops, jit-compiled once per batch shape.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+P = 2**255 - 19
+LIMBS = 20
+RADIX = 13
+MASK = (1 << RADIX) - 1  # 8191
+FOLD = 608  # 2^260 mod p = 19 * 2^5
+
+# 2*p in limb form, used as the additive pad for subtraction
+_TWO_P = 2 * P
+
+
+def int_to_limbs(v: int) -> np.ndarray:
+    """Python int -> (20,) int32 limb vector (host-side)."""
+    out = np.zeros(LIMBS, dtype=np.int32)
+    for i in range(LIMBS):
+        out[i] = v & MASK
+        v >>= RADIX
+    assert v == 0
+    return out
+
+
+def limbs_to_int(l) -> int:
+    l = np.asarray(l)
+    return sum(int(l[i]) << (RADIX * i) for i in range(LIMBS))
+
+
+def const_fe(v: int) -> jnp.ndarray:
+    """(20, 1) broadcastable constant."""
+    return jnp.asarray(int_to_limbs(v % P)).reshape(LIMBS, 1)
+
+
+TWO_P_LIMBS = jnp.asarray(int_to_limbs(_TWO_P)).reshape(LIMBS, 1)
+
+
+def zero_like(x):
+    return jnp.zeros_like(x)
+
+
+def carry(x: jnp.ndarray) -> jnp.ndarray:
+    """Sequential carry chain + top-limb fold -> weakly reduced.
+
+    Accepts limbs anywhere in int32 (including negatives, e.g. after sub);
+    arithmetic shifts make the carries floor-divide correctly.
+    """
+    limbs = [x[i] for i in range(LIMBS)]
+    for i in range(LIMBS - 1):
+        c = limbs[i] >> RADIX
+        limbs[i] = limbs[i] - (c << RADIX)
+        limbs[i + 1] = limbs[i + 1] + c
+    # fold bits >= 255 of the top limb (limb 19 holds bits 247..): 2^255 ≡ 19
+    t = limbs[LIMBS - 1] >> 8
+    limbs[LIMBS - 1] = limbs[LIMBS - 1] & 0xFF
+    limbs[0] = limbs[0] + t * 19
+    # short re-carry (t*19 < 2^23)
+    for i in range(2):
+        c = limbs[i] >> RADIX
+        limbs[i] = limbs[i] - (c << RADIX)
+        limbs[i + 1] = limbs[i + 1] + c
+    return jnp.stack(limbs)
+
+
+def _bcast(c, x):
+    """Reshape a (20, 1) constant to broadcast against x's trailing dims."""
+    return c.reshape((LIMBS,) + (1,) * (x.ndim - 1))
+
+
+def add(a, b):
+    return carry(a + b)
+
+
+def sub(a, b):
+    # a - b + 2p stays positive for weakly-reduced inputs
+    return carry(a - b + _bcast(TWO_P_LIMBS, a))
+
+
+def neg(a):
+    return carry(_bcast(TWO_P_LIMBS, a) - a)
+
+
+def mul(a, b):
+    """Full schoolbook multiply + fold + carry.  a, b weakly reduced."""
+    n = a.shape[1:]
+    prod = jnp.zeros((2 * LIMBS - 1,) + n, dtype=jnp.int32)
+    for j in range(LIMBS):
+        prod = prod.at[j : j + LIMBS].add(a * b[j][None])
+    lo = prod[:LIMBS]
+    hi = prod[LIMBS:]  # 19 limbs, each < 2^31
+    # normalize hi so the fold multiplications stay in int32
+    hlimbs = [hi[i] for i in range(LIMBS - 1)]
+    for i in range(LIMBS - 2):
+        c = hlimbs[i] >> RADIX
+        hlimbs[i] = hlimbs[i] - (c << RADIX)
+        hlimbs[i + 1] = hlimbs[i + 1] + c
+    htop = hlimbs[LIMBS - 2] >> RADIX  # final carry-out (< 2^18)
+    hlimbs[LIMBS - 2] = hlimbs[LIMBS - 2] - (htop << RADIX)
+    hi_n = jnp.stack(hlimbs)
+    lo = lo.at[: LIMBS - 1].add(hi_n * FOLD)
+    lo = lo.at[LIMBS - 1].add(htop * FOLD)
+    return carry(lo)
+
+
+def sqr(a):
+    return mul(a, a)
+
+
+def mul_small(a, k: int):
+    """Multiply by a small scalar constant (k < 2^17)."""
+    return carry(a * k)
+
+
+def _sq_n(x, n: int):
+    if n <= 4:
+        for _ in range(n):
+            x = sqr(x)
+        return x
+    return jax.lax.fori_loop(0, n, lambda _, v: sqr(v), x)
+
+
+def _pow_core(z):
+    """Shared prefix of the classic curve25519 exponentiation chains:
+    returns (z^(2^250 - 1), z^11, z^(2^5 - 1))."""
+    t0 = sqr(z)  # 2
+    t1 = mul(z, _sq_n(t0, 2))  # 9
+    t0 = mul(t0, t1)  # 11
+    t2 = sqr(t0)  # 22
+    t1 = mul(t1, t2)  # 31 = 2^5 - 1
+    z5 = t1
+    t2 = _sq_n(t1, 5)
+    t1 = mul(t1, t2)  # 2^10 - 1
+    t2 = mul(_sq_n(t1, 10), t1)  # 2^20 - 1
+    t3 = mul(_sq_n(t2, 20), t2)  # 2^40 - 1
+    t2 = mul(_sq_n(t3, 10), t1)  # 2^50 - 1
+    t3 = mul(_sq_n(t2, 50), t2)  # 2^100 - 1
+    t4 = mul(_sq_n(t3, 100), t3)  # 2^200 - 1
+    t3 = mul(_sq_n(t4, 50), t2)  # 2^250 - 1
+    return t3, t0, z5
+
+
+def inv(z):
+    """z^(p-2) = z^(2^255 - 21)."""
+    t3, z11, _ = _pow_core(z)
+    return mul(_sq_n(t3, 5), z11)  # 2^255 - 32 + 11 = 2^255 - 21
+
+
+def pow_p58(z):
+    """z^((p-5)/8) = z^(2^252 - 3)."""
+    t3, _, _ = _pow_core(z)
+    return mul(_sq_n(t3, 2), z)  # 2^252 - 4 + 1 = 2^252 - 3
+
+
+def canonical(x):
+    """Weakly-reduced -> fully reduced (< p), canonical limbs."""
+    x = carry(x)
+    # weakly reduced: x < p + ε < 2p, so at most one subtraction of p.
+    # lexicographic compare with p from the top limb down: x >= p?
+    p_limbs = int_to_limbs(P)
+    eq_so_far = jnp.ones_like(x[0], dtype=jnp.bool_)
+    gt = jnp.zeros_like(x[0], dtype=jnp.bool_)
+    for i in range(LIMBS - 1, -1, -1):
+        pi = int(p_limbs[i])
+        gt = gt | (eq_so_far & (x[i] > pi))
+        eq_so_far = eq_so_far & (x[i] == pi)
+    need_sub = gt | eq_so_far
+    sub_p = _bcast(jnp.asarray(int_to_limbs(P)).reshape(LIMBS, 1), x)
+    return carry(x - jnp.where(need_sub[None], sub_p, 0))
+
+
+def eq(a, b):
+    ca, cb = canonical(a), canonical(b)
+    return jnp.all(ca == cb, axis=0)
+
+
+def is_zero(a):
+    return jnp.all(canonical(a) == 0, axis=0)
+
+
+def parity(a):
+    """Least-significant bit of the canonical value."""
+    return canonical(a)[0] & 1
+
+
+def select(cond, a, b):
+    """cond: (N,) bool; a, b: (20, N)."""
+    return jnp.where(cond[None], a, b)
+
+
+# -- byte conversion (device) ----------------------------------------------
+def limbs_from_bytes(b):
+    """(32, N) int32 bytes (little-endian) -> (20, N) limbs.  The caller
+    masks the sign bit out of byte 31 first if decoding a point."""
+    limbs = []
+    for k in range(LIMBS):
+        bit0 = RADIX * k
+        j0, r0 = divmod(bit0, 8)
+        acc = b[j0] >> r0
+        width = 8 - r0
+        j = j0 + 1
+        while width < RADIX and j < 32:
+            acc = acc | (b[j] << width)
+            width += 8
+            j += 1
+        limbs.append(acc & MASK)
+    return jnp.stack(limbs)
+
+
+def bytes_from_limbs(x):
+    """canonical (20, N) limbs -> (32, N) int32 bytes little-endian."""
+    out = []
+    for j in range(32):
+        bit0 = 8 * j
+        k0, r0 = divmod(bit0, RADIX)
+        acc = x[k0] >> r0
+        width = RADIX - r0
+        if width < 8 and k0 + 1 < LIMBS:
+            acc = acc | (x[k0 + 1] << width)
+        out.append(acc & 0xFF)
+    return jnp.stack(out)

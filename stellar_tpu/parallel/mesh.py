@@ -1,0 +1,38 @@
+"""AcceleratorMesh — multi-chip sharding of the crypto data plane.
+
+SURVEY.md §2.14/§5.8: the reference's only intra-validator parallelism is a
+worker thread pool; the TPU-native axis is *batch data parallelism* of the
+signature-verify plane.  A verify batch is embarrassingly parallel over items,
+so the sharding story is one mesh axis ("batch"): inputs sharded over chips,
+no collectives needed in the kernel itself (XLA inserts the final all-gather
+of the (N,) bool output).
+
+The byzantine inter-validator plane stays on the overlay's TCP sockets —
+ICI/DCN collectives cannot replace signed flooding (SURVEY.md §5.8); this
+module is strictly the *inside-one-validator* scale-out.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+def make_mesh(devices: Optional[Sequence] = None, axis: str = "batch"):
+    """1-D device mesh over all (or given) local devices."""
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    return Mesh(np.asarray(devices), (axis,))
+
+
+def make_sharded_verifier(mesh=None, max_batch: int = 8192, **kw):
+    """BatchVerifier whose kernel is jit-sharded over the mesh's batch axis."""
+    from ..ops.ed25519 import BatchVerifier
+
+    if mesh is None:
+        mesh = make_mesh()
+    return BatchVerifier(max_batch=max_batch, mesh=mesh, **kw)

@@ -4,13 +4,29 @@ Tests run on a virtual 8-device CPU mesh (the reference's multi-node story is
 in-process simulation over a shared clock, SURVEY.md §4; our multi-chip story
 is jax.sharding over a Mesh, validated here without TPU hardware).  The real
 TPU chip is exercised by ``bench.py``, not by the unit suite.
+
+The environment boots a TPU-relay PJRT plugin ("axon") into every interpreter
+via sitecustomize; if the relay is unhealthy, any backend initialization
+hangs.  Tests must never depend on the relay, so we force CPU *and* drop the
+plugin's backend factory before any test imports jax.
 """
 
 import os
+import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+try:
+    import jax
+
+    # the environment's sitecustomize imports jax and latches
+    # jax_platforms to the relay backend before our env var is read;
+    # force it back to cpu through the live config
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass

@@ -1,0 +1,213 @@
+"""Differential tests: JAX batched ed25519 verify vs libsodium + pure-Python
+oracle (the bit-exactness requirement from BASELINE.md).
+
+Layers:
+1. field arithmetic vs Python ints (exhaustive op coverage, edge values)
+2. point ops vs the ref25519 oracle (which itself matches libsodium)
+3. BatchVerifier end-to-end vs libsodium: RFC 8032 vectors, random valid,
+   random mutated, and adversarial inputs (small-order points, non-canonical
+   scalars/field elements) — the libsodium strict-gate cases.
+
+Runs on CPU (conftest forces jax_platforms=cpu); the kernel compile (~70s)
+is amortized by the persistent compilation cache in stellar_tpu/ops.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from stellar_tpu.crypto import SecretKey, sodium  # noqa: E402
+from stellar_tpu.ops import fe, ref25519 as ref  # noqa: E402
+from stellar_tpu.ops import ed25519 as ed  # noqa: E402
+
+pytestmark = pytest.mark.tpu_kernel
+
+
+def _to_fe(vals):
+    return jnp.asarray(np.stack([fe.int_to_limbs(v) for v in vals], axis=1))
+
+
+def _from_fe(arr, i):
+    return fe.limbs_to_int(np.asarray(arr)[:, i])
+
+
+class TestFieldArithmetic:
+    P = ref.P
+
+    @pytest.fixture(scope="class")
+    def vals(self):
+        rng = random.Random(5)
+        return (
+            [rng.randrange(self.P) for _ in range(6)]
+            + [0, 1, 19, self.P - 1, 2**255 - 20, 2**255 - 19]
+        )
+
+    def test_mul_matches_python(self, vals):
+        a = _to_fe(vals)
+        b = _to_fe(list(reversed(vals)))
+        got = jax.jit(fe.mul)(a, b)
+        for i, (x, y) in enumerate(zip(vals, reversed(vals))):
+            assert _from_fe(got, i) % self.P == x * y % self.P
+
+    def test_sub_neg_matches_python(self, vals):
+        a = _to_fe(vals)
+        b = _to_fe(list(reversed(vals)))
+        got = jax.jit(fe.sub)(a, b)
+        for i, (x, y) in enumerate(zip(vals, reversed(vals))):
+            assert _from_fe(got, i) % self.P == (x - y) % self.P
+        gotn = jax.jit(fe.neg)(a)
+        for i, x in enumerate(vals):
+            assert _from_fe(gotn, i) % self.P == (-x) % self.P
+
+    def test_inv_and_p58(self, vals):
+        nz = [v if v else 7 for v in vals]
+        a = _to_fe(nz)
+        got = jax.jit(fe.inv)(a)
+        for i, x in enumerate(nz):
+            assert _from_fe(got, i) % self.P == pow(x, self.P - 2, self.P)
+        got = jax.jit(fe.pow_p58)(a)
+        for i, x in enumerate(nz):
+            assert _from_fe(got, i) % self.P == pow(x, (self.P - 5) // 8, self.P)
+
+    def test_canonical_edges(self):
+        edge = [0, 1, self.P - 1, self.P, self.P + 5, 2**255 - 1]
+        got = jax.jit(fe.canonical)(_to_fe(edge))
+        for i, v in enumerate(edge):
+            assert _from_fe(got, i) == v % self.P
+
+    def test_byte_roundtrip(self):
+        rng = random.Random(9)
+        vals = [rng.randrange(self.P) for _ in range(4)]
+        bts = np.zeros((32, 4), dtype=np.int32)
+        for i, v in enumerate(vals):
+            bts[:, i] = np.frombuffer(v.to_bytes(32, "little"), dtype=np.uint8)
+        lim = fe.limbs_from_bytes(jnp.asarray(bts))
+        assert [_from_fe(lim, i) for i in range(4)] == vals
+        back = np.asarray(fe.bytes_from_limbs(jax.jit(fe.canonical)(lim)))
+        assert np.array_equal(back, bts)
+
+
+class TestPointOps:
+    @pytest.fixture(scope="class")
+    def points(self):
+        rng = random.Random(11)
+        pts = []
+        while len(pts) < 4:
+            y = rng.randrange(ref.P)
+            pt = ref.decompress(int.to_bytes(y | (rng.randrange(2) << 255), 32, "little"))
+            if pt is not None:
+                pts.append(pt)
+        return pts
+
+    @staticmethod
+    def _dev(pts):
+        return tuple(
+            jnp.asarray(
+                np.stack([fe.int_to_limbs(p[c] % ref.P) for p in pts], axis=1)
+            )
+            for c in range(4)
+        )
+
+    @staticmethod
+    def _host(P4, i):
+        return tuple(_from_fe(P4[c], i) % ref.P for c in range(4))
+
+    def test_add_double_vs_oracle(self, points):
+        d = self._dev(points)
+        got = jax.jit(ed.point_add)(d, d)
+        got2 = jax.jit(ed.point_double)(d)
+        for i, p in enumerate(points):
+            want = ref.point_add(p, p)
+            assert ref.point_equal(self._host(got, i), want)
+            assert ref.point_equal(self._host(got2, i), want)
+
+    def test_identity_neutral(self, points):
+        d = self._dev(points)
+        ident = ed.point_identity(len(points))
+        got = jax.jit(ed.point_add)(d, ident)
+        for i, p in enumerate(points):
+            assert ref.point_equal(self._host(got, i), p)
+
+    def test_compress_decompress_roundtrip(self, points):
+        d = self._dev(points)
+        enc = np.asarray(jax.jit(ed.compress)(d))
+        for i, p in enumerate(points):
+            assert bytes(enc[:, i].astype(np.uint8)) == ref.compress(p)
+
+
+class TestBatchVerifier:
+    @pytest.fixture(scope="class")
+    def bv(self):
+        return ed.BatchVerifier(max_batch=64, min_device_batch=16)
+
+    def test_rfc8032_vectors(self, bv):
+        """RFC 8032 §7.1 TEST 1-3."""
+        cases = [
+            (
+                "9d61b19deffd5a60ba844af492ec2cc44449c5697b326919703bac031cae7f60",
+                b"",
+            ),
+            (
+                "4ccd089b28ff96da9db6c346ec114e0f5b8a319f35aba624da8cf6ed4fb8a6fb",
+                b"\x72",
+            ),
+            (
+                "c5aa8df43f9f837bedb7442f31dcb7b166d38535076f094b85ce3a2e0b4458f7",
+                b"\xaf\x82",
+            ),
+        ]
+        items = []
+        for seed_hex, msg in cases:
+            sk = SecretKey.from_seed(bytes.fromhex(seed_hex))
+            items.append((sk.public_raw, msg, sk.sign(msg)))
+        assert bv.verify(items) == [True, True, True]
+
+    def test_differential_random_mutations(self, bv):
+        rng = random.Random(1234)
+        items = []
+        for i in range(48):
+            sk = SecretKey.pseudo_random_for_testing(i)
+            msg = bytes([rng.randrange(256) for _ in range(rng.randrange(0, 100))])
+            sig = bytearray(sk.sign(msg))
+            if i % 2:
+                sig[rng.randrange(64)] ^= 1 << rng.randrange(8)
+            items.append((sk.public_raw, msg, bytes(sig)))
+        want = [sodium.verify_detached(s, m, p) for p, m, s in items]
+        assert bv.verify(items) == want
+
+    def test_adversarial_inputs_match_libsodium(self, bv):
+        sk = SecretKey.pseudo_random_for_testing(0)
+        msg = b"m"
+        sig = sk.sign(msg)
+        adv = []
+        for e in ref.small_order_blacklist():
+            adv.append((e, msg, sig))  # small-order pk
+            adv.append((sk.public_raw, msg, e + sig[32:]))  # small-order R
+        bad_s = (int.from_bytes(sig[32:], "little") + ref.L).to_bytes(32, "little")
+        adv.append((sk.public_raw, msg, sig[:32] + bad_s))  # s >= L
+        adv.append(((2**255 - 5).to_bytes(32, "little"), msg, sig))  # y >= p
+        adv.append((sk.public_raw, msg, b"\x00" * 64))  # zero sig
+        want = [sodium.verify_detached(s, m, p) for p, m, s in adv]
+        got = bv.verify(adv)
+        assert got == want
+        assert not any(got)  # everything here must be rejected
+
+    def test_cross_batch_consistency(self, bv):
+        """Same item alone and inside a padded batch must agree."""
+        sk = SecretKey.pseudo_random_for_testing(3)
+        item = (sk.public_raw, b"solo", sk.sign(b"solo"))
+        assert bv.verify([item]) == [True]
+        batch = [item] * 33
+        assert bv.verify(batch) == [True] * 33
+
+    def test_empty_and_gate_only_batches(self, bv):
+        assert bv.verify([]) == []
+        # all items fail the host gate -> no device call needed
+        calls_before = bv.n_device_calls
+        bad = [(b"\x00" * 32, b"m", b"\x00" * 64)] * 3
+        assert bv.verify(bad) == [False, False, False]
+        assert bv.n_device_calls == calls_before

@@ -1,0 +1,423 @@
+"""Transaction suite (reference style: src/transactions/*Tests.cpp against a
+standalone app with in-memory sqlite, SURVEY.md §4 layer 3)."""
+
+import pytest
+
+import stellar_tpu.xdr as X
+from stellar_tpu.crypto import SecretKey
+from stellar_tpu.main.application import Application
+from stellar_tpu.tx import testutils as T
+from stellar_tpu.util import VIRTUAL_TIME, VirtualClock
+
+RC = X.TransactionResultCode
+
+
+@pytest.fixture
+def clock():
+    c = VirtualClock(VIRTUAL_TIME)
+    yield c
+    c.shutdown()
+
+
+@pytest.fixture
+def app(clock):
+    a = Application(clock, T.get_test_config(), new_db=True)
+    yield a
+    a.database.close()
+
+
+@pytest.fixture
+def root(app):
+    return T.root_key_for(app)
+
+
+def root_seq(app, root):
+    from stellar_tpu.ledger.accountframe import AccountFrame
+
+    return AccountFrame.load_account(root.get_public_key(), app.database).get_seq_num()
+
+
+def fund(app, root, dest, amount=None):
+    amount = amount or 10_000 * 10**7
+    tx = T.tx_from_ops(app, root, root_seq(app, root) + 1,
+                       [T.create_account_op(dest, amount)])
+    T.apply_tx(app, tx, expect_code=RC.txSUCCESS)
+    return dest
+
+
+class TestGenesis:
+    def test_master_account_created(self, app, root):
+        from stellar_tpu.ledger.accountframe import AccountFrame
+
+        master = AccountFrame.load_account(root.get_public_key(), app.database)
+        assert master is not None
+        assert master.get_balance() == 10**18
+        assert app.ledger_manager.last_closed.header.ledgerSeq == 1
+        assert app.ledger_manager.current.header.ledgerSeq == 2
+
+
+class TestCreateAccount:
+    def test_create_and_balance(self, app, root):
+        dest = T.get_account(1)
+        fund(app, root, dest, 5000 * 10**7)
+        from stellar_tpu.ledger.accountframe import AccountFrame
+
+        acc = AccountFrame.load_account(dest.get_public_key(), app.database)
+        assert acc.get_balance() == 5000 * 10**7
+        # starting seq = ledgerSeq << 32
+        assert acc.get_seq_num() == app.ledger_manager.current.header.ledgerSeq << 32
+
+    def test_create_below_reserve_fails(self, app, root):
+        dest = T.get_account(1)
+        tx = T.tx_from_ops(
+            app, root, root_seq(app, root) + 1, [T.create_account_op(dest, 1)]
+        )
+        T.apply_tx(app, tx, expect_code=RC.txFAILED)
+        assert (
+            T.inner_op_code(tx)
+            == X.CreateAccountResultCode.CREATE_ACCOUNT_LOW_RESERVE
+        )
+
+    def test_create_duplicate_fails(self, app, root):
+        dest = T.get_account(1)
+        fund(app, root, dest)
+        tx = T.tx_from_ops(
+            app, root, root_seq(app, root) + 1,
+            [T.create_account_op(dest, 10**10)],
+        )
+        T.apply_tx(app, tx, expect_code=RC.txFAILED)
+        assert (
+            T.inner_op_code(tx)
+            == X.CreateAccountResultCode.CREATE_ACCOUNT_ALREADY_EXIST
+        )
+
+
+class TestPayment:
+    def test_native_payment(self, app, root):
+        a = fund(app, root, T.get_account(1))
+        b = fund(app, root, T.get_account(2))
+        tx = T.tx_from_ops(app, a, (2 << 32) + 1, [T.payment_op(b, 10**7)])
+        T.apply_tx(app, tx, expect_code=RC.txSUCCESS)
+        from stellar_tpu.ledger.accountframe import AccountFrame
+
+        bacc = AccountFrame.load_account(b.get_public_key(), app.database)
+        assert bacc.get_balance() == 10_000 * 10**7 + 10**7
+
+    def test_payment_underfunded(self, app, root):
+        a = fund(app, root, T.get_account(1), 300 * 10**7)
+        b = fund(app, root, T.get_account(2))
+        tx = T.tx_from_ops(app, a, (2 << 32) + 1, [T.payment_op(b, 10**12)])
+        T.apply_tx(app, tx, expect_code=RC.txFAILED)
+        assert T.inner_op_code(tx) == X.PaymentResultCode.PAYMENT_UNDERFUNDED
+
+    def test_payment_to_missing_account(self, app, root):
+        a = fund(app, root, T.get_account(1))
+        ghost = T.get_account(99)
+        tx = T.tx_from_ops(app, a, (2 << 32) + 1, [T.payment_op(ghost, 10**7)])
+        T.apply_tx(app, tx, expect_code=RC.txFAILED)
+        assert T.inner_op_code(tx) == X.PaymentResultCode.PAYMENT_NO_DESTINATION
+
+    def test_bad_signature_rejected(self, app, root):
+        a = fund(app, root, T.get_account(1))
+        b = fund(app, root, T.get_account(2))
+        evil = T.get_account(666)
+        tx_xdr = X.Transaction(
+            sourceAccount=a.get_public_key(),
+            fee=100,
+            seqNum=(2 << 32) + 1,
+            memo=X.Memo.none(),
+            operations=[T.payment_op(b, 10**7)],
+        )
+        from stellar_tpu.tx.frame import TransactionFrame
+
+        frame = TransactionFrame(app.network_id, X.TransactionEnvelope(tx_xdr, []))
+        frame.add_signature(evil)  # signed by the wrong key
+        assert not frame.check_valid(app, 0)
+        assert frame.get_result_code() == RC.txBAD_AUTH
+
+    def test_sequence_gap_rejected(self, app, root):
+        a = fund(app, root, T.get_account(1))
+        b = fund(app, root, T.get_account(2))
+        tx = T.tx_from_ops(app, a, (2 << 32) + 7, [T.payment_op(b, 10**7)])
+        assert not tx.check_valid(app, 0)
+        assert tx.get_result_code() == RC.txBAD_SEQ
+
+    def test_fee_charged_even_on_failure(self, app, root):
+        a = fund(app, root, T.get_account(1), 500 * 10**7)
+        b = fund(app, root, T.get_account(2))
+        from stellar_tpu.ledger.accountframe import AccountFrame
+
+        before = AccountFrame.load_account(a.get_public_key(), app.database).get_balance()
+        tx = T.tx_from_ops(app, a, (2 << 32) + 1, [T.payment_op(b, 10**13)])
+        T.apply_tx(app, tx, expect_code=RC.txFAILED)
+        AccountFrame.cache_of(app.database).clear()
+        after = AccountFrame.load_account(a.get_public_key(), app.database).get_balance()
+        assert after == before - 100  # fee gone, payment rolled back
+
+
+class TestMultisig:
+    def test_add_signer_and_threshold(self, app, root):
+        a = fund(app, root, T.get_account(1))
+        s1 = T.get_account(11)
+        # add signer weight 1, raise med threshold to 2 => payments need both
+        tx = T.tx_from_ops(
+            app, a, (2 << 32) + 1,
+            [T.set_options_op(med=2, high=2,
+                              signer=X.Signer(s1.get_public_key(), 1))],
+        )
+        T.apply_tx(app, tx, expect_code=RC.txSUCCESS)
+        b = fund(app, root, T.get_account(2))
+        # master alone (weight 1) insufficient for medium=2
+        tx = T.tx_from_ops(app, a, (2 << 32) + 2, [T.payment_op(b, 10**7)])
+        assert not tx.check_valid(app, 0)
+        assert tx.result.result.value[0].type == X.OperationResultCode.opBAD_AUTH
+        # master + signer => passes
+        tx = T.tx_from_ops(app, a, (2 << 32) + 2, [T.payment_op(b, 10**7)])
+        tx.add_signature(s1)
+        assert tx.check_valid(app, 0)
+
+    def test_extra_signature_rejected(self, app, root):
+        a = fund(app, root, T.get_account(1))
+        b = fund(app, root, T.get_account(2))
+        stranger = T.get_account(12)
+        tx = T.tx_from_ops(app, a, (2 << 32) + 1, [T.payment_op(b, 10**7)])
+        tx.add_signature(stranger)  # unused signature
+        assert not tx.check_valid(app, 0)
+        assert tx.get_result_code() == RC.txBAD_AUTH_EXTRA
+
+
+class TestTrustAndCredit:
+    def test_trust_and_credit_payment(self, app, root):
+        issuer = fund(app, root, T.get_account(1))
+        holder = fund(app, root, T.get_account(2))
+        usd = X.Asset.alphanum4(b"USD", issuer.get_public_key())
+        T.apply_tx(
+            app,
+            T.tx_from_ops(app, holder, (2 << 32) + 1,
+                          [T.change_trust_op(usd, 10**10)]),
+            expect_code=RC.txSUCCESS,
+        )
+        T.apply_tx(
+            app,
+            T.tx_from_ops(app, issuer, (2 << 32) + 1,
+                          [T.payment_op(holder, 500, usd)]),
+            expect_code=RC.txSUCCESS,
+        )
+        from stellar_tpu.ledger.trustframe import TrustFrame
+
+        line = TrustFrame.load_trust_line(holder.get_public_key(), usd, app.database)
+        assert line.get_balance() == 500
+
+    def test_payment_without_trust_fails(self, app, root):
+        issuer = fund(app, root, T.get_account(1))
+        holder = fund(app, root, T.get_account(2))
+        usd = X.Asset.alphanum4(b"USD", issuer.get_public_key())
+        tx = T.tx_from_ops(
+            app, issuer, (2 << 32) + 1, [T.payment_op(holder, 500, usd)]
+        )
+        T.apply_tx(app, tx, expect_code=RC.txFAILED)
+        assert T.inner_op_code(tx) == X.PaymentResultCode.PAYMENT_NO_TRUST
+
+    def test_auth_required_flow(self, app, root):
+        issuer = fund(app, root, T.get_account(1))
+        holder = fund(app, root, T.get_account(2))
+        # issuer requires auth
+        T.apply_tx(
+            app,
+            T.tx_from_ops(app, issuer, (2 << 32) + 1,
+                          [T.set_options_op(set_flags=0x1)]),
+            expect_code=RC.txSUCCESS,
+        )
+        usd = X.Asset.alphanum4(b"USD", issuer.get_public_key())
+        T.apply_tx(
+            app,
+            T.tx_from_ops(app, holder, (2 << 32) + 1,
+                          [T.change_trust_op(usd, 10**10)]),
+            expect_code=RC.txSUCCESS,
+        )
+        # unauthorized: payment fails
+        tx = T.tx_from_ops(
+            app, issuer, (2 << 32) + 2, [T.payment_op(holder, 5, usd)]
+        )
+        T.apply_tx(app, tx, expect_code=RC.txFAILED)
+        assert T.inner_op_code(tx) == X.PaymentResultCode.PAYMENT_NOT_AUTHORIZED
+        # authorize, then it works
+        T.apply_tx(
+            app,
+            T.tx_from_ops(app, issuer, (2 << 32) + 3,
+                          [T.allow_trust_op(holder, b"USD", True)]),
+            expect_code=RC.txSUCCESS,
+        )
+        T.apply_tx(
+            app,
+            T.tx_from_ops(app, issuer, (2 << 32) + 4,
+                          [T.payment_op(holder, 5, usd)]),
+            expect_code=RC.txSUCCESS,
+        )
+
+
+class TestOffersAndPathPayment:
+    def _setup_market(self, app, root):
+        issuer = fund(app, root, T.get_account(1))
+        seller = fund(app, root, T.get_account(2))
+        buyer = fund(app, root, T.get_account(3))
+        usd = X.Asset.alphanum4(b"USD", issuer.get_public_key())
+        for who in (seller, buyer):
+            T.apply_tx(
+                app,
+                T.tx_from_ops(app, who, (2 << 32) + 1,
+                              [T.change_trust_op(usd, 10**12)]),
+                expect_code=RC.txSUCCESS,
+            )
+        T.apply_tx(
+            app,
+            T.tx_from_ops(app, issuer, (2 << 32) + 1,
+                          [T.payment_op(seller, 10**6, usd)]),
+            expect_code=RC.txSUCCESS,
+        )
+        return issuer, seller, buyer, usd
+
+    def test_manage_offer_created(self, app, root):
+        issuer, seller, buyer, usd = self._setup_market(app, root)
+        # seller sells USD for XLM at 2 XLM/USD
+        tx = T.tx_from_ops(
+            app, seller, (2 << 32) + 2,
+            [T.manage_offer_op(usd, X.Asset.native(), 1000, X.Price(2, 1))],
+        )
+        T.apply_tx(app, tx, expect_code=RC.txSUCCESS)
+        res = T.op_result_of(tx).value.value
+        assert res.type == X.ManageOfferResultCode.MANAGE_OFFER_SUCCESS
+        assert res.value.offer.type == X.ManageOfferEffect.MANAGE_OFFER_CREATED
+
+    def test_offer_crossing(self, app, root):
+        issuer, seller, buyer, usd = self._setup_market(app, root)
+        T.apply_tx(
+            app,
+            T.tx_from_ops(
+                app, seller, (2 << 32) + 2,
+                [T.manage_offer_op(usd, X.Asset.native(), 1000, X.Price(2, 1))],
+            ),
+            expect_code=RC.txSUCCESS,
+        )
+        # buyer sells XLM for USD at matching price -> crosses
+        tx = T.tx_from_ops(
+            app, buyer, (2 << 32) + 2,
+            [T.manage_offer_op(X.Asset.native(), usd, 2000, X.Price(1, 2))],
+        )
+        T.apply_tx(app, tx, expect_code=RC.txSUCCESS)
+        res = T.op_result_of(tx).value.value
+        assert res.value.offersClaimed, "expected the resting offer to be taken"
+        from stellar_tpu.ledger.trustframe import TrustFrame
+
+        line = TrustFrame.load_trust_line(buyer.get_public_key(), usd, app.database)
+        assert line.get_balance() == 1000
+
+    def test_path_payment_through_book(self, app, root):
+        issuer, seller, buyer, usd = self._setup_market(app, root)
+        T.apply_tx(
+            app,
+            T.tx_from_ops(
+                app, seller, (2 << 32) + 2,
+                [T.manage_offer_op(usd, X.Asset.native(), 1000, X.Price(2, 1))],
+            ),
+            expect_code=RC.txSUCCESS,
+        )
+        # buyer pays holder 100 USD, sourced from native through the book
+        holder = fund(app, root, T.get_account(4))
+        T.apply_tx(
+            app,
+            T.tx_from_ops(app, holder, (2 << 32) + 1,
+                          [T.change_trust_op(usd, 10**12)]),
+            expect_code=RC.txSUCCESS,
+        )
+        tx = T.tx_from_ops(
+            app, buyer, (2 << 32) + 2,
+            [T.path_payment_op(holder, X.Asset.native(), 10**6, usd, 100)],
+        )
+        T.apply_tx(app, tx, expect_code=RC.txSUCCESS)
+        from stellar_tpu.ledger.trustframe import TrustFrame
+
+        line = TrustFrame.load_trust_line(holder.get_public_key(), usd, app.database)
+        assert line.get_balance() == 100
+
+
+class TestMerge:
+    def test_merge_moves_balance(self, app, root):
+        a = fund(app, root, T.get_account(1), 1000 * 10**7)
+        b = fund(app, root, T.get_account(2))
+        from stellar_tpu.ledger.accountframe import AccountFrame
+
+        a_bal = AccountFrame.load_account(a.get_public_key(), app.database).get_balance()
+        tx = T.tx_from_ops(app, a, (2 << 32) + 1, [T.merge_op(b)])
+        T.apply_tx(app, tx, expect_code=RC.txSUCCESS)
+        assert AccountFrame.load_account(a.get_public_key(), app.database) is None
+        AccountFrame.cache_of(app.database).clear()
+        b_acc = AccountFrame.load_account(b.get_public_key(), app.database)
+        assert b_acc.get_balance() == 10_000 * 10**7 + a_bal - 100  # minus fee
+
+    def test_merge_with_trustline_fails(self, app, root):
+        issuer = fund(app, root, T.get_account(1))
+        a = fund(app, root, T.get_account(2))
+        usd = X.Asset.alphanum4(b"USD", issuer.get_public_key())
+        T.apply_tx(
+            app,
+            T.tx_from_ops(app, a, (2 << 32) + 1, [T.change_trust_op(usd, 10**9)]),
+            expect_code=RC.txSUCCESS,
+        )
+        tx = T.tx_from_ops(app, a, (2 << 32) + 2, [T.merge_op(issuer)])
+        T.apply_tx(app, tx, expect_code=RC.txFAILED)
+        assert (
+            T.inner_op_code(tx)
+            == X.AccountMergeResultCode.ACCOUNT_MERGE_HAS_SUB_ENTRIES
+        )
+
+
+class TestLedgerClose:
+    def test_close_ledger_with_txset(self, app, root):
+        from stellar_tpu.herder.ledgerclose import LedgerCloseData
+        from stellar_tpu.herder.txset import TxSetFrame
+
+        a = T.get_account(1)
+        lm = app.ledger_manager
+        tx = T.tx_from_ops(
+            app, root, root_seq(app, root) + 1,
+            [T.create_account_op(a, 10**10)],
+        )
+        txset = TxSetFrame(lm.last_closed.hash, [tx])
+        assert txset.check_valid(app)
+        sv = X.StellarValue(txset.get_contents_hash(), 1, [], 0)
+        lm.close_ledger(LedgerCloseData(lm.current.header.ledgerSeq, txset, sv))
+        assert lm.last_closed.header.ledgerSeq == 2
+        assert lm.last_closed.header.scpValue.closeTime == 1
+        from stellar_tpu.ledger.accountframe import AccountFrame
+
+        assert AccountFrame.load_account(a.get_public_key(), app.database) is not None
+        # header chain stored
+        from stellar_tpu.ledger.headerframe import LedgerHeaderFrame
+
+        h2 = LedgerHeaderFrame.load_by_sequence(app.database, 2)
+        assert h2.header.previousLedgerHash is not None
+        h1 = LedgerHeaderFrame.load_by_sequence(app.database, 1)
+        assert h2.header.previousLedgerHash == h1.get_hash()
+
+    def test_close_rejects_wrong_prev_hash(self, app, root):
+        from stellar_tpu.herder.ledgerclose import LedgerCloseData
+        from stellar_tpu.herder.txset import TxSetFrame
+
+        lm = app.ledger_manager
+        txset = TxSetFrame(b"\x00" * 32, [])
+        sv = X.StellarValue(txset.get_contents_hash(), 1, [], 0)
+        with pytest.raises(RuntimeError):
+            lm.close_ledger(LedgerCloseData(2, txset, sv))
+
+    def test_txset_invalid_with_bad_seq(self, app, root):
+        from stellar_tpu.herder.txset import TxSetFrame
+
+        a = T.get_account(1)
+        lm = app.ledger_manager
+        tx = T.tx_from_ops(
+            app, root, root_seq(app, root) + 5,  # gap
+            [T.create_account_op(a, 10**10)],
+        )
+        txset = TxSetFrame(lm.last_closed.hash, [tx])
+        assert not txset.check_valid(app)
