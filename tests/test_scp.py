@@ -1,0 +1,546 @@
+"""SCP consensus library tests.
+
+In the spirit of the reference's scripted-driver suites
+(src/scp/SCPTests.cpp `TestSCP : public SCPDriver`, SCPUnitTests.cpp):
+no network, no Application — a fake driver captures emitted envelopes and
+timers, and tests drive nodes envelope-by-envelope through nomination and
+the prepare/confirm/externalize ballot machine.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from stellar_tpu.crypto import SecretKey
+from stellar_tpu.scp import SCP, EnvelopeState, SCPDriver, quorum
+from stellar_tpu.scp.ballot import UINT32_MAX, Phase
+from stellar_tpu.xdr.scp import (
+    SCPBallot,
+    SCPEnvelope,
+    SCPNomination,
+    SCPQuorumSet,
+    SCPStatement,
+    SCPStatementConfirm,
+    SCPStatementExternalize,
+    SCPStatementPledges,
+    SCPStatementPrepare,
+    SCPStatementType,
+)
+
+ST = SCPStatementType
+
+KEYS = [SecretKey.pseudo_random_for_testing(i) for i in range(5)]
+NODES = [k.get_public_key() for k in KEYS]
+
+X = b"\x01" * 32
+Y = b"\x02" * 32  # X < Y
+
+
+def qset5(threshold=4) -> SCPQuorumSet:
+    return SCPQuorumSet(threshold=threshold, validators=list(NODES), innerSets=[])
+
+
+class ScriptedDriver(SCPDriver):
+    """Scripted driver: no real crypto, captured emissions and timers."""
+
+    def __init__(self, qsets=()):
+        self.emitted = []
+        self.externalized = {}  # slot -> value
+        self.qsets = {quorum.qset_hash(q): q for q in qsets}
+        self.timers = {}  # (slot, timer_id) -> (timeout, cb)
+        self.heard = []
+        self.expected_candidates = set()
+        self.composite = b""
+
+    def sign_envelope(self, envelope):
+        envelope.signature = b"sig!"
+
+    def verify_envelope(self, envelope):
+        return True
+
+    def get_qset(self, qset_hash):
+        return self.qsets.get(qset_hash)
+
+    def store_qset(self, q):
+        self.qsets[quorum.qset_hash(q)] = q
+
+    def emit_envelope(self, envelope):
+        self.emitted.append(envelope)
+
+    def combine_candidates(self, slot_index, candidates):
+        if self.expected_candidates:
+            assert candidates == self.expected_candidates
+        if self.composite:
+            return self.composite
+        return b"".join(sorted(candidates))
+
+    def setup_timer(self, slot_index, timer_id, timeout, cb):
+        self.timers[(slot_index, timer_id)] = (timeout, cb)
+
+    def value_externalized(self, slot_index, value):
+        assert slot_index not in self.externalized
+        self.externalized[slot_index] = value
+
+    def ballot_did_hear_from_quorum(self, slot_index, ballot):
+        self.heard.append((slot_index, ballot))
+
+
+def make_env(node_idx: int, slot: int, pledges: SCPStatementPledges) -> SCPEnvelope:
+    st = SCPStatement(nodeID=NODES[node_idx], slotIndex=slot, pledges=pledges)
+    return SCPEnvelope(statement=st, signature=b"sig!")
+
+
+def prepare_st(qs_hash, ballot, prepared=None, prepared_prime=None, nC=0, nP=0):
+    return SCPStatementPledges(
+        ST.SCP_ST_PREPARE,
+        SCPStatementPrepare(
+            quorumSetHash=qs_hash,
+            ballot=ballot,
+            prepared=prepared,
+            preparedPrime=prepared_prime,
+            nC=nC,
+            nP=nP,
+        ),
+    )
+
+
+def confirm_st(qs_hash, n_prepared, commit, nP):
+    return SCPStatementPledges(
+        ST.SCP_ST_CONFIRM,
+        SCPStatementConfirm(quorumSetHash=qs_hash, nPrepared=n_prepared, commit=commit, nP=nP),
+    )
+
+
+def externalize_st(qs_hash, commit, nP):
+    return SCPStatementPledges(
+        ST.SCP_ST_EXTERNALIZE,
+        SCPStatementExternalize(commit=commit, nP=nP, commitQuorumSetHash=qs_hash),
+    )
+
+
+def nominate_st(qs_hash, votes, accepted):
+    return SCPStatementPledges(
+        ST.SCP_ST_NOMINATE,
+        SCPNomination(quorumSetHash=qs_hash, votes=sorted(votes), accepted=sorted(accepted)),
+    )
+
+
+# ---------------------------------------------------------------------------
+# quorum-set math (reference: SCPUnitTests.cpp, SCPTests.cpp:318 "vblocking
+# and quorum")
+# ---------------------------------------------------------------------------
+
+
+class TestQuorumMath:
+    def test_flat_slice_and_vblocking(self):
+        q = SCPQuorumSet(threshold=3, validators=NODES[:4], innerSets=[])
+        assert quorum.is_quorum_slice(q, set(NODES[:3]))
+        assert not quorum.is_quorum_slice(q, set(NODES[:2]))
+        # v-blocking: entries - threshold = 1 → any 2 nodes block
+        assert quorum.is_v_blocking(q, set(NODES[:2]))
+        assert not quorum.is_v_blocking(q, {NODES[0]})
+
+    def test_vblocking_empty_requirement(self):
+        q = SCPQuorumSet(threshold=0, validators=[], innerSets=[])
+        assert not quorum.is_v_blocking(q, set(NODES))
+
+    def test_nested(self):
+        inner = SCPQuorumSet(threshold=2, validators=NODES[2:5], innerSets=[])
+        q = SCPQuorumSet(threshold=2, validators=NODES[:2], innerSets=[inner])
+        # {v0, v1} satisfies (2 validators)
+        assert quorum.is_quorum_slice(q, set(NODES[:2]))
+        # {v0, v2} does not (inner unsatisfied)
+        assert not quorum.is_quorum_slice(q, {NODES[0], NODES[2]})
+        # {v0, v2, v3} does (v0 + inner)
+        assert quorum.is_quorum_slice(q, {NODES[0], NODES[2], NODES[3]})
+
+    def test_node_weight(self):
+        q = qset5(4)
+        w = quorum.node_weight(NODES[0], q)
+        assert w == quorum.UINT64_MAX * 4 // 5
+        inner = SCPQuorumSet(threshold=1, validators=[NODES[4]], innerSets=[])
+        q2 = SCPQuorumSet(threshold=1, validators=NODES[:2], innerSets=[inner])
+        w2 = quorum.node_weight(NODES[4], q2)
+        assert w2 == (quorum.UINT64_MAX * 1 // 1) * 1 // 3
+        assert quorum.node_weight(NODES[3], q2) == 0
+
+    def test_qset_sane(self):
+        assert quorum.is_qset_sane(NODES[0], qset5())
+        # threshold out of range
+        bad = SCPQuorumSet(threshold=6, validators=list(NODES), innerSets=[])
+        assert not quorum.is_qset_sane(NODES[0], bad)
+        bad0 = SCPQuorumSet(threshold=0, validators=list(NODES), innerSets=[])
+        assert not quorum.is_qset_sane(NODES[0], bad0)
+        # author missing
+        q = SCPQuorumSet(threshold=1, validators=NODES[1:3], innerSets=[])
+        assert not quorum.is_qset_sane(NODES[0], q)
+        assert quorum.is_qset_sane(NODES[0], q, allow_self_absent=True)
+
+    def test_is_quorum_transitive(self):
+        q = qset5(4)
+        d = ScriptedDriver([q])
+        envs = {
+            NODES[i]: make_env(i, 1, prepare_st(quorum.qset_hash(q), SCPBallot(1, X)))
+            for i in range(4)
+        }
+        assert quorum.is_quorum_with(
+            q, envs, lambda st: d.get_qset(st.pledges.prepare.quorumSetHash), lambda st: True
+        )
+        del envs[NODES[3]]
+        assert not quorum.is_quorum_with(
+            q, envs, lambda st: d.get_qset(st.pledges.prepare.quorumSetHash), lambda st: True
+        )
+
+
+# ---------------------------------------------------------------------------
+# ballot protocol (reference: SCPTests.cpp:352 "ballot protocol core5")
+# ---------------------------------------------------------------------------
+
+
+class Core5:
+    """v0 under test in a 5-node threshold-4 network."""
+
+    def __init__(self):
+        self.qset = qset5(4)
+        self.qs_hash = quorum.qset_hash(self.qset)
+        self.driver = ScriptedDriver([self.qset])
+        self.scp = SCP(self.driver, NODES[0], True, self.qset)
+
+    def recv(self, node_idx, pledges, slot=1):
+        return self.scp.receive_envelope(make_env(node_idx, slot, pledges))
+
+    def recv_vblocking(self, make_pledges, slot=1):
+        for i in (1, 2):
+            assert self.recv(i, make_pledges(), slot) == EnvelopeState.VALID
+
+    def recv_quorum(self, make_pledges, slot=1):
+        """Envelopes from v1..v3; with v0's own statement that is a quorum."""
+        for i in (1, 2, 3):
+            assert self.recv(i, make_pledges(), slot) == EnvelopeState.VALID
+
+    @property
+    def emitted(self):
+        return self.driver.emitted
+
+    def last_emit(self):
+        return self.emitted[-1].statement.pledges
+
+    def bp(self, slot=1):
+        return self.scp.get_slot(slot).ballot
+
+
+class TestBallotProtocol:
+    def test_bump_emits_prepare(self):
+        n = Core5()
+        assert n.scp.get_slot(1).bump_state(X, force=True)
+        assert len(n.emitted) == 1
+        pl = n.last_emit()
+        assert pl.type == ST.SCP_ST_PREPARE
+        assert pl.prepare.ballot == SCPBallot(1, X)
+        assert pl.prepare.prepared is None
+
+    def test_normal_round_1x(self):
+        """The full happy path: prepare → prepared → confirmed prepared →
+        accept commit → confirm commit → externalize."""
+        n = Core5()
+        n.scp.get_slot(1).bump_state(X, force=True)
+
+        # quorum votes (1,x) → v0 accepts it prepared
+        n.recv_quorum(lambda: prepare_st(n.qs_hash, SCPBallot(1, X)))
+        pl = n.last_emit()
+        assert pl.prepare.prepared == SCPBallot(1, X)
+        assert pl.prepare.nC == 0 and pl.prepare.nP == 0
+
+        # quorum accepts prepared → v0 confirms prepared, sets c and P
+        n.recv_quorum(
+            lambda: prepare_st(n.qs_hash, SCPBallot(1, X), prepared=SCPBallot(1, X))
+        )
+        pl = n.last_emit()
+        assert pl.type == ST.SCP_ST_PREPARE
+        assert pl.prepare.nC == 1 and pl.prepare.nP == 1
+
+        # quorum votes commit [1,1] → v0 accepts commit → CONFIRM
+        n.recv_quorum(
+            lambda: prepare_st(
+                n.qs_hash, SCPBallot(1, X), prepared=SCPBallot(1, X), nC=1, nP=1
+            )
+        )
+        pl = n.last_emit()
+        assert pl.type == ST.SCP_ST_CONFIRM
+        assert pl.confirm.commit == SCPBallot(1, X)
+        assert pl.confirm.nPrepared == 1 and pl.confirm.nP == 1
+        assert n.bp().phase == Phase.CONFIRM
+        assert n.bp().current.counter == UINT32_MAX
+
+        # quorum confirms commit → EXTERNALIZE
+        n.recv_quorum(lambda: confirm_st(n.qs_hash, 1, SCPBallot(1, X), 1))
+        pl = n.last_emit()
+        assert pl.type == ST.SCP_ST_EXTERNALIZE
+        assert pl.externalize.commit == SCPBallot(1, X)
+        assert n.driver.externalized == {1: X}
+        assert n.bp().phase == Phase.EXTERNALIZE
+
+    def test_prepared_by_vblocking(self):
+        """Two nodes accepting (1,y) prepared is v-blocking → v0 follows even
+        though it prepared (1,x)."""
+        n = Core5()
+        n.scp.get_slot(1).bump_state(X, force=True)
+        n.recv_vblocking(
+            lambda: prepare_st(n.qs_hash, SCPBallot(1, Y), prepared=SCPBallot(1, Y))
+        )
+        assert n.bp().prepared == SCPBallot(1, Y)
+
+    def test_prepared_prime(self):
+        """x<y: prepared (1,y) then (2,x) → p=(2,x), p'=(1,y)."""
+        n = Core5()
+        n.scp.get_slot(1).bump_state(Y, force=True)
+        n.recv_vblocking(
+            lambda: prepare_st(n.qs_hash, SCPBallot(1, Y), prepared=SCPBallot(1, Y))
+        )
+        assert n.bp().prepared == SCPBallot(1, Y)
+        n.recv_vblocking(
+            lambda: prepare_st(n.qs_hash, SCPBallot(2, X), prepared=SCPBallot(2, X))
+        )
+        assert n.bp().prepared == SCPBallot(2, X)
+        assert n.bp().prepared_prime == SCPBallot(1, Y)
+        pl = n.last_emit()
+        assert pl.prepare.prepared == SCPBallot(2, X)
+        assert pl.prepare.preparedPrime == SCPBallot(1, Y)
+
+    def test_pristine_prepared_by_vblocking_no_bump(self):
+        """A single prepared statement on a pristine slot is not v-blocking →
+        nothing happens (SCPTests.cpp:1210)."""
+        n = Core5()
+        assert (
+            n.recv(1, prepare_st(n.qs_hash, SCPBallot(1, Y), prepared=SCPBallot(1, Y)))
+            == EnvelopeState.VALID
+        )
+        assert n.bp().prepared is None
+        assert n.emitted == []
+
+    def test_confirm_on_pristine_slot_vblocking(self):
+        """v-blocking CONFIRMs adopt the commit even from nothing."""
+        n = Core5()
+        n.recv_vblocking(lambda: confirm_st(n.qs_hash, 2, SCPBallot(2, Y), 2))
+        # v-blocking set accepted commit ⇒ v0 accepts prepared(2,y) via
+        # its accept rule, moving the machine forward
+        assert n.bp().prepared is not None
+
+    def test_externalize_envelopes_accepted_after_externalize(self):
+        n = Core5()
+        n.scp.get_slot(1).bump_state(X, force=True)
+        n.recv_quorum(lambda: prepare_st(n.qs_hash, SCPBallot(1, X)))
+        n.recv_quorum(
+            lambda: prepare_st(n.qs_hash, SCPBallot(1, X), prepared=SCPBallot(1, X))
+        )
+        n.recv_quorum(
+            lambda: prepare_st(
+                n.qs_hash, SCPBallot(1, X), prepared=SCPBallot(1, X), nC=1, nP=1
+            )
+        )
+        n.recv_quorum(lambda: confirm_st(n.qs_hash, 1, SCPBallot(1, X), 1))
+        assert n.bp().phase == Phase.EXTERNALIZE
+        # late EXTERNALIZE about the same value: accepted
+        assert n.recv(4, externalize_st(n.qs_hash, SCPBallot(1, X), 1)) == EnvelopeState.VALID
+        # incompatible value: rejected
+        assert n.recv(4, externalize_st(n.qs_hash, SCPBallot(1, Y), 1)) == EnvelopeState.INVALID
+
+    def test_stale_statement_rejected(self):
+        n = Core5()
+        st = prepare_st(n.qs_hash, SCPBallot(2, X))
+        assert n.recv(1, st) == EnvelopeState.VALID
+        # same statement again: stale
+        assert n.recv(1, prepare_st(n.qs_hash, SCPBallot(2, X))) == EnvelopeState.INVALID
+        # lower ballot: stale
+        assert n.recv(1, prepare_st(n.qs_hash, SCPBallot(1, X))) == EnvelopeState.INVALID
+
+    def test_malformed_statements_rejected(self):
+        n = Core5()
+        # counter 0
+        assert n.recv(1, prepare_st(n.qs_hash, SCPBallot(0, X))) == EnvelopeState.INVALID
+        # prepared above ballot
+        assert (
+            n.recv(1, prepare_st(n.qs_hash, SCPBallot(1, X), prepared=SCPBallot(2, X)))
+            == EnvelopeState.INVALID
+        )
+        # nP without prepared
+        assert (
+            n.recv(1, prepare_st(n.qs_hash, SCPBallot(1, X), nP=1)) == EnvelopeState.INVALID
+        )
+        # confirm commit counter 0
+        assert n.recv(1, confirm_st(n.qs_hash, 1, SCPBallot(0, X), 1)) == EnvelopeState.INVALID
+        # unknown quorum set
+        assert (
+            n.recv(1, prepare_st(b"\x99" * 32, SCPBallot(1, X))) == EnvelopeState.INVALID
+        )
+
+    def test_timeout_bumps_counter(self):
+        from stellar_tpu.scp import BALLOT_PROTOCOL_TIMER
+
+        n = Core5()
+        n.scp.get_slot(1).bump_state(X, force=True)
+        # timer armed; heard_from_quorum is false until a quorum speaks at
+        # our counter
+        _, cb = n.driver.timers[(1, BALLOT_PROTOCOL_TIMER)]
+        n.recv_quorum(lambda: prepare_st(n.qs_hash, SCPBallot(1, X)))
+        assert n.driver.heard  # quorum at counter 1
+        cb()  # fire timer → abandon → bump to counter 2
+        assert n.bp().current.counter == 2
+
+    def test_timeout_waits_for_quorum(self):
+        from stellar_tpu.scp import BALLOT_PROTOCOL_TIMER
+
+        n = Core5()
+        n.scp.get_slot(1).bump_state(X, force=True)
+        _, cb = n.driver.timers[(1, BALLOT_PROTOCOL_TIMER)]
+        cb()  # no quorum heard yet → stays at counter 1, timer re-armed
+        assert n.bp().current.counter == 1
+
+    def test_restore_prepare_state(self):
+        n = Core5()
+        n.scp.get_slot(1).bump_state(X, force=True)
+        n.recv_quorum(lambda: prepare_st(n.qs_hash, SCPBallot(1, X)))
+        saved = n.scp.get_latest_messages_send(1)
+        assert len(saved) == 1
+
+        n2 = Core5()
+        for e in saved:
+            n2.scp.set_state_from_envelope(1, e)
+        assert n2.bp().current == SCPBallot(1, X)
+        assert n2.bp().prepared == SCPBallot(1, X)
+        assert n2.bp().phase == Phase.PREPARE
+
+    def test_restore_confirm_state(self):
+        n = Core5()
+        n.scp.get_slot(1).bump_state(X, force=True)
+        n.recv_quorum(lambda: prepare_st(n.qs_hash, SCPBallot(1, X)))
+        n.recv_quorum(
+            lambda: prepare_st(n.qs_hash, SCPBallot(1, X), prepared=SCPBallot(1, X))
+        )
+        n.recv_quorum(
+            lambda: prepare_st(
+                n.qs_hash, SCPBallot(1, X), prepared=SCPBallot(1, X), nC=1, nP=1
+            )
+        )
+        assert n.bp().phase == Phase.CONFIRM
+        saved = n.scp.get_latest_messages_send(1)
+
+        n2 = Core5()
+        for e in saved:
+            n2.scp.set_state_from_envelope(1, e)
+        assert n2.bp().phase == Phase.CONFIRM
+        assert n2.bp().commit == SCPBallot(1, X)
+
+    def test_value_rejected_by_driver(self):
+        class RejectingDriver(ScriptedDriver):
+            def validate_value(self, slot_index, value):
+                return value != Y
+
+        q = qset5(4)
+        d = RejectingDriver([q])
+        scp = SCP(d, NODES[0], True, q)
+        env = make_env(1, 1, prepare_st(quorum.qset_hash(q), SCPBallot(1, Y)))
+        assert scp.receive_envelope(env) == EnvelopeState.INVALID
+
+    def test_purge_slots(self):
+        n = Core5()
+        for i in (1, 2, 3):
+            n.scp.get_slot(i).bump_state(X, force=True)
+        n.scp.purge_slots(3)
+        assert sorted(n.scp.known_slots) == [3]
+
+
+# ---------------------------------------------------------------------------
+# nomination (reference: SCPTests.cpp:1486 "nomination tests core5")
+# ---------------------------------------------------------------------------
+
+
+class TestNomination:
+    def test_single_node_network_externalizes_instantly(self):
+        """threshold-1 self-only qset (the FORCE_SCP standalone config):
+        nominate → instant candidate → ballot → externalize."""
+        q = SCPQuorumSet(threshold=1, validators=[NODES[0]], innerSets=[])
+        d = ScriptedDriver([q])
+        scp = SCP(d, NODES[0], True, q)
+        assert scp.nominate(1, X, previous_value=b"\x00" * 32)
+        assert d.externalized == {1: X}
+
+    def test_others_nominate_x_prepare_x(self):
+        """v0 nominates; votes for x from a quorum promote x to accepted,
+        then candidate, then the ballot protocol starts on the composite."""
+        n = Core5()
+        n.driver.expected_candidates = {X}
+        n.driver.composite = X
+        n.scp.nominate(1, X, previous_value=b"\x00" * 32)
+
+        for i in (1, 2, 3, 4):
+            n.recv(i, nominate_st(n.qs_hash, votes=[X], accepted=[]))
+        nom = n.scp.get_slot(1).nomination
+        assert X in nom.accepted or X in nom.votes
+
+        for i in (1, 2, 3, 4):
+            n.recv(i, nominate_st(n.qs_hash, votes=[X], accepted=[X]))
+        assert X in nom.candidates
+        # ballot protocol started on the combined value
+        assert n.bp().current is not None
+        assert n.bp().current.value == X
+        assert n.driver.timers  # nomination timer armed
+
+    def test_vblocking_accept_promotes(self):
+        """4 nodes accepting x is v-blocking → v0 accepts x without ever
+        voting for it."""
+        n = Core5()
+        n.scp.nominate(1, Y, previous_value=b"\x00" * 32)
+        for i in (1, 2):
+            n.recv(i, nominate_st(n.qs_hash, votes=[X], accepted=[X]))
+        nom = n.scp.get_slot(1).nomination
+        assert X in nom.accepted
+
+    def test_nomination_stale_and_malformed(self):
+        n = Core5()
+        assert (
+            n.recv(1, nominate_st(n.qs_hash, votes=[X, Y], accepted=[]))
+            == EnvelopeState.VALID
+        )
+        # subset (not newer) → invalid
+        assert (
+            n.recv(1, nominate_st(n.qs_hash, votes=[X], accepted=[]))
+            == EnvelopeState.INVALID
+        )
+        # empty nomination → invalid
+        assert n.recv(2, nominate_st(n.qs_hash, votes=[], accepted=[])) == EnvelopeState.INVALID
+        # unsorted votes → invalid
+        unsorted = SCPStatementPledges(
+            ST.SCP_ST_NOMINATE,
+            SCPNomination(quorumSetHash=n.qs_hash, votes=[Y, X], accepted=[]),
+        )
+        assert n.recv(3, unsorted) == EnvelopeState.INVALID
+
+    def test_nomination_restore_state(self):
+        n = Core5()
+        n.driver.composite = X
+        n.scp.nominate(1, X, previous_value=b"\x00" * 32)
+        for i in (1, 2, 3, 4):
+            n.recv(i, nominate_st(n.qs_hash, votes=[X], accepted=[X]))
+        saved = n.scp.get_latest_messages_send(1)
+        nom_envs = [
+            e for e in saved if e.statement.pledges.type == ST.SCP_ST_NOMINATE
+        ]
+        assert nom_envs
+
+        n2 = Core5()
+        for e in nom_envs:
+            n2.scp.set_state_from_envelope(1, e)
+        nom2 = n2.scp.get_slot(1).nomination
+        assert X in nom2.votes
+
+    def test_timer_renominate(self):
+        from stellar_tpu.scp import NOMINATION_TIMER
+
+        n = Core5()
+        n.scp.nominate(1, X, previous_value=b"\x00" * 32)
+        assert (1, NOMINATION_TIMER) in n.driver.timers
+        _, cb = n.driver.timers[(1, NOMINATION_TIMER)]
+        round_before = n.scp.get_slot(1).nomination.round_number
+        cb()
+        assert n.scp.get_slot(1).nomination.round_number == round_before + 1
