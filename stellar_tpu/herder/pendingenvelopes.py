@@ -1,0 +1,192 @@
+"""PendingEnvelopes — holds SCP envelopes until their dependencies are here
+(reference: src/herder/PendingEnvelopes.{h,cpp}).
+
+An SCP envelope can only be fed to consensus once its companion quorum set
+and every tx set its values reference are locally known; missing items are
+anycast-fetched from peers through the overlay's ItemFetchers.  Caches are
+LRU so a malicious flood of hashes can't grow memory unboundedly.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+from ..util import xlog
+from ..xdr.ledger import StellarValue
+from ..xdr.overlay import MessageType
+from ..xdr.scp import SCPEnvelope, SCPQuorumSet
+from ..scp.quorum import qset_hash as compute_qset_hash
+
+log = xlog.logger("Herder")
+
+QSET_CACHE_SIZE = 10000
+TXSET_CACHE_SIZE = 10000
+
+
+class _LRU:
+    def __init__(self, cap: int):
+        self.cap = cap
+        self.d: OrderedDict = OrderedDict()
+
+    def get(self, k):
+        if k in self.d:
+            self.d.move_to_end(k)
+            return self.d[k]
+        return None
+
+    def put(self, k, v):
+        self.d[k] = v
+        self.d.move_to_end(k)
+        while len(self.d) > self.cap:
+            self.d.popitem(last=False)
+
+    def __contains__(self, k):
+        return k in self.d
+
+
+class PendingEnvelopes:
+    def __init__(self, app, herder):
+        self.app = app
+        self.herder = herder
+        # slot -> {envelope_bytes: envelope}
+        self.processed: Dict[int, Dict[bytes, SCPEnvelope]] = {}
+        self.fetching: Dict[int, Dict[bytes, SCPEnvelope]] = {}
+        self.pending: Dict[int, List[SCPEnvelope]] = {}
+        self.qset_cache = _LRU(QSET_CACHE_SIZE)
+        self.txset_cache = _LRU(TXSET_CACHE_SIZE)
+        self._size_counter = app.metrics.new_counter(
+            ("scp", "memory", "pending-envelopes")
+        )
+
+    # -- item arrival -------------------------------------------------------
+    def recv_scp_quorum_set(self, qs_hash: bytes, qset: SCPQuorumSet) -> None:
+        self.qset_cache.put(qs_hash, qset)
+        om = self.app.overlay_manager
+        if om is not None:
+            om.qset_fetcher.recv(qs_hash)
+        self._recheck_fetching()
+
+    def recv_tx_set(self, ts_hash: bytes, txset) -> None:
+        self.txset_cache.put(ts_hash, txset)
+        om = self.app.overlay_manager
+        if om is not None:
+            om.tx_set_fetcher.recv(ts_hash)
+        self._recheck_fetching()
+
+    def get_qset(self, qs_hash: bytes) -> Optional[SCPQuorumSet]:
+        return self.qset_cache.get(qs_hash)
+
+    def get_tx_set(self, ts_hash: bytes):
+        return self.txset_cache.get(ts_hash)
+
+    def peer_doesnt_have(self, msg_type: MessageType, item_id: bytes, peer) -> None:
+        om = self.app.overlay_manager
+        if om is None:
+            return
+        if msg_type == MessageType.TX_SET:
+            om.tx_set_fetcher.doesnt_have(item_id, peer)
+        elif msg_type == MessageType.SCP_QUORUMSET:
+            om.qset_fetcher.doesnt_have(item_id, peer)
+
+    # -- dependencies -------------------------------------------------------
+    def _required_items(self, envelope: SCPEnvelope):
+        """(qset_hash, [txset hashes]) the envelope depends on."""
+        from ..scp.slot import Slot
+
+        st = envelope.statement
+        qs = Slot.companion_qset_hash(st)  # None for EXTERNALIZE (self-quorum)
+        txsets = []
+        for v in Slot.statement_values(st):
+            try:
+                sv = StellarValue.from_xdr(v)
+            except Exception:
+                continue
+            txsets.append(sv.txSetHash)
+        return qs, txsets
+
+    def is_fully_fetched(self, envelope: SCPEnvelope) -> bool:
+        qs, txsets = self._required_items(envelope)
+        if qs is not None and qs not in self.qset_cache:
+            return False
+        return all(h in self.txset_cache for h in txsets)
+
+    def _start_fetch(self, envelope: SCPEnvelope) -> None:
+        om = self.app.overlay_manager
+        if om is None:
+            return
+        qs, txsets = self._required_items(envelope)
+        if qs is not None and qs not in self.qset_cache:
+            om.qset_fetcher.fetch(qs, envelope)
+        for h in txsets:
+            if h not in self.txset_cache:
+                om.tx_set_fetcher.fetch(h, envelope)
+
+    # -- envelope flow ------------------------------------------------------
+    def recv_scp_envelope(self, envelope: SCPEnvelope) -> None:
+        slot = envelope.statement.slotIndex
+        key = envelope.to_xdr()
+        if key in self.processed.get(slot, {}):
+            return
+        if key in self.fetching.get(slot, {}):
+            return
+        if self.is_fully_fetched(envelope):
+            self._envelope_ready(envelope)
+        else:
+            self.fetching.setdefault(slot, {})[key] = envelope
+            self._size_counter.inc()
+            self._start_fetch(envelope)
+
+    def _envelope_ready(self, envelope: SCPEnvelope) -> None:
+        slot = envelope.statement.slotIndex
+        key = envelope.to_xdr()
+        self.processed.setdefault(slot, {})[key] = envelope
+        # flood the now-complete envelope onward (PendingEnvelopes.cpp
+        # envelopeReady) — the Floodgate dedups, so relaying here is what
+        # lets consensus traverse non-fully-meshed topologies
+        om = self.app.overlay_manager
+        if om is not None:
+            from ..xdr.overlay import StellarMessage
+
+            om.broadcast_message(
+                StellarMessage(MessageType.SCP_MESSAGE, envelope)
+            )
+        self.pending.setdefault(slot, []).append(envelope)
+        self.herder.process_scp_queue()
+
+    def _recheck_fetching(self) -> None:
+        ready = []
+        for slot, envs in self.fetching.items():
+            for key, env in list(envs.items()):
+                if self.is_fully_fetched(env):
+                    del envs[key]
+                    self._size_counter.dec()
+                    ready.append(env)
+        for env in ready:
+            self._envelope_ready(env)
+
+    def pop(self, slot_index: int) -> Optional[SCPEnvelope]:
+        lst = self.pending.get(slot_index)
+        if lst:
+            return lst.pop(0)
+        return None
+
+    def ready_slots(self) -> List[int]:
+        return sorted(s for s, lst in self.pending.items() if lst)
+
+    def erase_below(self, slot_index: int) -> None:
+        for d in (self.processed, self.fetching, self.pending):
+            for s in [s for s in d if s < slot_index]:
+                del d[s]
+
+    def slot_closed(self, slot_index: int) -> None:
+        """Drop all state at or below the closed slot (keep newer)."""
+        self.erase_below(slot_index + 1)
+
+    def dump_info(self) -> dict:
+        return {
+            "pending": {s: len(v) for s, v in self.pending.items()},
+            "fetching": {s: len(v) for s, v in self.fetching.items()},
+            "qsets": len(self.qset_cache.d),
+            "txsets": len(self.txset_cache.d),
+        }

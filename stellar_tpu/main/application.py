@@ -113,6 +113,10 @@ class Application:
             self.command_handler.stop()
         self.database.close()
 
+    def time_now(self) -> int:
+        """Current time as unix seconds on this app's clock (Application::timeNow)."""
+        return int(self.clock.now())
+
     # -- cross-subsystem notifications -------------------------------------
     def herder_notify_ledger_closed(self) -> None:
         if self.herder is not None:

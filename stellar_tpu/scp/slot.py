@@ -83,19 +83,28 @@ class Slot:
             return list(nom.votes) + list(nom.accepted)
         return [working_ballot(st).value]
 
+    @staticmethod
+    def companion_qset_hash(st: SCPStatement) -> Optional[bytes]:
+        """The quorum-set hash a statement depends on; None for EXTERNALIZE,
+        which stands alone (Slot.cpp getCompanionQuorumSetHashFromStatement —
+        there EXTERNALIZE still names its last qset, but nothing resolves
+        through it: the statement is treated as a self-quorum)."""
+        t = st.pledges.type
+        if t == ST.SCP_ST_PREPARE:
+            return st.pledges.prepare.quorumSetHash
+        if t == ST.SCP_ST_CONFIRM:
+            return st.pledges.confirm.quorumSetHash
+        if t == ST.SCP_ST_NOMINATE:
+            return st.pledges.nominate.quorumSetHash
+        return None
+
     def quorum_set_from_statement(self, st: SCPStatement) -> Optional[SCPQuorumSet]:
         """EXTERNALIZE carries no qset promise anymore — the node is
         committed alone; everything else names a qset by hash, resolved
         through the driver's cache."""
-        t = st.pledges.type
-        if t == ST.SCP_ST_EXTERNALIZE:
+        h = self.companion_qset_hash(st)
+        if h is None:
             return quorum.singleton_qset(st.nodeID)
-        if t == ST.SCP_ST_PREPARE:
-            h = st.pledges.prepare.quorumSetHash
-        elif t == ST.SCP_ST_CONFIRM:
-            h = st.pledges.confirm.quorumSetHash
-        else:
-            h = st.pledges.nominate.quorumSetHash
         return self.driver.get_qset(h)
 
     # -- federated voting ----------------------------------------------------------

@@ -19,15 +19,26 @@ TEST_PASSPHRASE = "(V) (;,,;) (V) test network"
 
 
 def get_test_config(instance: int = 0, backend: str = "cpu") -> Config:
+    """Per-instance test config (reference: main/test.cpp:36 getTestConfig):
+    in-memory sqlite, standalone, manual close, deterministic node seed,
+    self-quorum, FORCE_SCP."""
+    from ..xdr.scp import SCPQuorumSet
+
     cfg = Config()
     cfg.NETWORK_PASSPHRASE = TEST_PASSPHRASE
     cfg.DATABASE = "sqlite3://:memory:"
     cfg.RUN_STANDALONE = True
     cfg.MANUAL_CLOSE = True
-    cfg.HTTP_PORT = 0
+    cfg.HTTP_PORT = 39100 + instance * 2
     cfg.PEER_PORT = 39200 + instance * 2
     cfg.TMP_DIR_PATH = f"/tmp/stellar-tpu-test-{instance}"
     cfg.SIGNATURE_BACKEND = backend
+    cfg.NODE_SEED = SecretKey.from_seed(
+        bytes([instance % 256]) + b"test-node-seed".ljust(31, b"\x00")
+    )
+    cfg.NODE_IS_VALIDATOR = True
+    cfg.FORCE_SCP = True
+    cfg.QUORUM_SET = SCPQuorumSet(1, [cfg.NODE_SEED.get_public_key()], [])
     return cfg
 
 

@@ -547,3 +547,24 @@ def xdr_to_opaque(*items: Any) -> bytes:
         else:
             codec_of(it).pack_into(it, out)
     return bytes(out)
+
+
+def pack_var_array_of(cls, items) -> bytes:
+    """XDR xvector<T> encoding of `items` (count + each element)."""
+    out = bytearray()
+    var_array(codec_of(cls)).pack_into(list(items), out)
+    return bytes(out)
+
+
+def unpack_var_arrays(data: bytes, classes) -> Tuple[list, ...]:
+    """Decode consecutive xvector<T> blocks — the layout xdrpp produces for
+    `xdr_to_opaque(vecA, vecB, ...)` (e.g. the persisted SCP state blob,
+    HerderImpl.cpp:1482)."""
+    offset = 0
+    out = []
+    for cls in classes:
+        lst, offset = var_array(codec_of(cls)).unpack_from(data, offset)
+        out.append(lst)
+    if offset != len(data):
+        raise XdrError("trailing bytes after var arrays")
+    return tuple(out)
