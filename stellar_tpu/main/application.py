@@ -111,6 +111,8 @@ class Application:
             self.overlay_manager.shutdown()
         if self.command_handler is not None:
             self.command_handler.stop()
+        if self.process_manager is not None:
+            self.process_manager.shutdown()
         self.database.close()
 
     def time_now(self) -> int:

@@ -1,0 +1,268 @@
+"""CommandHandler — HTTP admin interface
+(reference: src/main/CommandHandler.{h,cpp}, routes at CommandHandler.cpp:62-92).
+
+A minimal HTTP/1.0 GET server running on the node's VirtualClock selector
+(same single-reactor model as the overlay).  Routes mirror the reference:
+/info /metrics /peers /scp /tx /manualclose /connect /ll /catchup
+/maintenance /dropcursor /setcursor /logrotate /generateload /checkpoint.
+Submit transactions with ``/tx?blob=<hex XDR TransactionEnvelope>``.
+"""
+
+from __future__ import annotations
+
+import json
+import selectors
+import socket
+from typing import Callable, Dict, Optional
+from urllib.parse import parse_qsl, urlparse
+
+from ..util import xlog
+from ..xdr.base import xdr_to_opaque
+from ..xdr.txs import TransactionEnvelope
+
+log = xlog.logger("Overlay")
+
+MAX_REQUEST = 1 << 20
+
+
+class CommandHandler:
+    def __init__(self, app):
+        self.app = app
+        self.sock: Optional[socket.socket] = None
+        self.routes: Dict[str, Callable[[dict], object]] = {
+            "info": self.handle_info,
+            "metrics": self.handle_metrics,
+            "peers": self.handle_peers,
+            "scp": self.handle_scp,
+            "tx": self.handle_tx,
+            "manualclose": self.handle_manual_close,
+            "connect": self.handle_connect,
+            "ll": self.handle_ll,
+            "catchup": self.handle_catchup,
+            "maintenance": self.handle_maintenance,
+            "dropcursor": self.handle_dropcursor,
+            "setcursor": self.handle_setcursor,
+            "checkpoint": self.handle_checkpoint,
+            "generateload": self.handle_generateload,
+            "logrotate": lambda q: {"status": "ok"},
+        }
+
+    # -- server plumbing ----------------------------------------------------
+    def start(self) -> None:
+        cfg = self.app.config
+        if cfg.HTTP_PORT == 0:
+            return
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.setblocking(False)
+        host = "0.0.0.0" if cfg.PUBLIC_HTTP_PORT else "127.0.0.1"
+        try:
+            s.bind((host, cfg.HTTP_PORT))
+            s.listen(16)
+        except OSError as e:
+            log.warning("admin http could not listen on %d: %s", cfg.HTTP_PORT, e)
+            s.close()
+            return
+        self.sock = s
+        self.app.clock.watch(s, selectors.EVENT_READ, self._on_accept)
+        log.info("admin http listening on %s:%d", host, cfg.HTTP_PORT)
+
+    def stop(self) -> None:
+        if self.sock is not None:
+            self.app.clock.unwatch(self.sock)
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+            self.sock = None
+
+    def _on_accept(self, _events) -> None:
+        while True:
+            try:
+                conn, _ = self.sock.accept()
+            except (BlockingIOError, OSError):
+                return
+            conn.setblocking(False)
+            buf = bytearray()
+
+            def on_io(events, conn=conn, buf=buf):
+                try:
+                    chunk = conn.recv(65536)
+                except (BlockingIOError, InterruptedError):
+                    return
+                except OSError:
+                    self.app.clock.unwatch(conn)
+                    conn.close()
+                    return
+                if chunk:
+                    buf += chunk
+                if (not chunk) or b"\r\n\r\n" in buf or len(buf) > MAX_REQUEST:
+                    self.app.clock.unwatch(conn)
+                    self._respond(conn, bytes(buf))
+
+            self.app.clock.watch(conn, selectors.EVENT_READ, on_io)
+
+    def _respond(self, conn: socket.socket, raw: bytes) -> None:
+        status, body = 200, b""
+        try:
+            line = raw.split(b"\r\n", 1)[0].decode("latin-1")
+            parts = line.split(" ")
+            target = parts[1] if len(parts) >= 2 else "/"
+            body_obj = self.execute(target)
+            body = (
+                body_obj
+                if isinstance(body_obj, bytes)
+                else json.dumps(body_obj, indent=1).encode()
+            )
+        except KeyError:
+            status, body = 404, b'{"error": "unknown command"}'
+        except Exception as e:
+            log.warning("admin command failed: %s", e)
+            status, body = 500, json.dumps({"error": str(e)}).encode()
+        reason = {200: "OK", 404: "Not Found", 500: "Error"}[status]
+        hdr = (
+            f"HTTP/1.0 {status} {reason}\r\n"
+            f"Content-Type: application/json\r\n"
+            f"Content-Length: {len(body)}\r\n\r\n"
+        ).encode()
+        try:
+            # responses can exceed the send buffer (e.g. /metrics); go
+            # blocking with a timeout for the single write-out
+            conn.setblocking(True)
+            conn.settimeout(5.0)
+            conn.sendall(hdr + body)
+        except OSError:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def execute(self, target: str):
+        """Dispatch a request path like '/info' or 'tx?blob=...'; also the
+        entry for config-file COMMANDS (Application::applyCfgCommands)."""
+        u = urlparse(target if target.startswith("/") else "/" + target)
+        cmd = u.path.strip("/")
+        params = dict(parse_qsl(u.query))
+        fn = self.routes[cmd]
+        return fn(params)
+
+    # -- routes -------------------------------------------------------------
+    def handle_info(self, q: dict) -> dict:
+        app = self.app
+        lm = app.ledger_manager
+        lcl = lm.last_closed
+        info = {
+            "state": app.get_state(),
+            "ledger": {
+                "num": lm.get_last_closed_ledger_num() if lcl else 0,
+                "hash": lcl.hash.hex() if lcl else None,
+                "closeTime": lcl.header.scpValue.closeTime if lcl else 0,
+            },
+            "numPeers": (
+                app.overlay_manager.get_authenticated_peer_count()
+                if app.overlay_manager
+                else 0
+            ),
+            "network": app.config.NETWORK_PASSPHRASE,
+            "build": app.config.VERSION_STR,
+        }
+        return {"info": info}
+
+    def handle_metrics(self, q: dict) -> dict:
+        return {"metrics": self.app.metrics.to_json()}
+
+    def handle_peers(self, q: dict) -> dict:
+        om = self.app.overlay_manager
+        return om.dump_info() if om else {"peers": []}
+
+    def handle_scp(self, q: dict) -> dict:
+        h = self.app.herder
+        return h.dump_info() if h else {}
+
+    def handle_tx(self, q: dict) -> dict:
+        """Submit a hex-XDR TransactionEnvelope (CommandHandler.cpp:92 'tx')."""
+        from ..tx.frame import TransactionFrame
+
+        blob = q.get("blob")
+        if not blob:
+            raise ValueError("missing 'blob' param")
+        env = TransactionEnvelope.from_xdr(bytes.fromhex(blob))
+        tx = TransactionFrame.make_from_wire(self.app.network_id, env)
+        status = self.app.herder.recv_transaction(tx)
+        out = {"status": status}
+        if status == "PENDING" and self.app.overlay_manager is not None:
+            self.app.overlay_manager.broadcast_message(tx.to_stellar_message())
+        elif status == "ERROR":
+            out["error"] = xdr_to_opaque(tx.result).hex()
+        return out
+
+    def handle_manual_close(self, q: dict) -> dict:
+        if not self.app.config.MANUAL_CLOSE:
+            raise ValueError("MANUAL_CLOSE not set in config")
+        self.app.herder.trigger_next_ledger(
+            self.app.ledger_manager.get_ledger_num()
+        )
+        return {"status": "closing"}
+
+    def handle_connect(self, q: dict) -> dict:
+        from ..overlay.peerrecord import PeerRecord
+
+        peer, port = q.get("peer"), q.get("port")
+        if not peer or not port:
+            raise ValueError("must specify peer and port")
+        pr = PeerRecord(peer, int(port))
+        self.app.overlay_manager.connect_to(pr)
+        return {"status": "connecting"}
+
+    def handle_ll(self, q: dict) -> dict:
+        level = q.get("level")
+        partition = q.get("partition")
+        if level:
+            xlog.set_log_level(level, partition)
+        return {"status": "ok", "level": level, "partition": partition or "all"}
+
+    def handle_catchup(self, q: dict) -> dict:
+        mode = q.get("mode", "minimal")
+        self.app.ledger_manager.start_catchup()
+        return {"status": "catching up", "mode": mode}
+
+    def handle_maintenance(self, q: dict) -> dict:
+        from .externalqueue import ExternalQueue
+
+        if q.get("queue") == "true":
+            count = int(q.get("count", 50000))
+            ExternalQueue(self.app.database).delete_old_entries(count)
+            return {"status": "done"}
+        return {"status": "No work performed"}
+
+    def handle_dropcursor(self, q: dict) -> dict:
+        from .externalqueue import ExternalQueue
+
+        ExternalQueue(self.app.database).delete_cursor(q.get("id", ""))
+        return {"status": "ok"}
+
+    def handle_setcursor(self, q: dict) -> dict:
+        from .externalqueue import ExternalQueue
+
+        ExternalQueue(self.app.database).set_cursor_for_resource(
+            q.get("id", ""), int(q.get("cursor", 0))
+        )
+        return {"status": "ok"}
+
+    def handle_checkpoint(self, q: dict) -> dict:
+        hm = self.app.history_manager
+        n = hm.publish_queued_history() if hasattr(hm, "publish_queued_history") else 0
+        return {"status": "ok", "publishing": n}
+
+    def handle_generateload(self, q: dict) -> dict:
+        from ..simulation.loadgen import LoadGenerator
+
+        accounts = int(q.get("accounts", 1000))
+        txs = int(q.get("txs", 1000))
+        rate = int(q.get("txrate", 10))
+        if not hasattr(self.app, "load_generator") or self.app.load_generator is None:
+            self.app.load_generator = LoadGenerator()
+        self.app.load_generator.generate_load(self.app, accounts, txs, rate)
+        return {"status": f"Generating load: {accounts} accounts, {txs} txs, {rate} tx/s"}

@@ -1,0 +1,91 @@
+"""Floodgate — at-most-once flood dedup (reference: src/overlay/Floodgate.{h,cpp}).
+
+Keyed by message hash; each record remembers which peers already have the
+message so a broadcast only sends to the rest.  Records are GC'd as ledgers
+close (``clear_below`` keeps the last two ledgers, Floodgate.cpp:46-58).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Set
+
+from ..crypto import sha256
+from ..util import xlog
+from ..xdr.base import xdr_to_opaque
+from ..xdr.overlay import StellarMessage
+
+log = xlog.logger("Overlay")
+
+
+class FloodRecord:
+    __slots__ = ("ledger_seq", "message", "peers_told")
+
+    def __init__(self, ledger_seq: int, message: StellarMessage):
+        self.ledger_seq = ledger_seq
+        self.message = message
+        self.peers_told: Set[object] = set()
+
+
+class Floodgate:
+    def __init__(self, app):
+        self.app = app
+        self.flood_map: Dict[bytes, FloodRecord] = {}
+        self._shutting_down = False
+        self.m_added = app.metrics.new_counter(("overlay", "memory", "flood-known"))
+
+    @staticmethod
+    def message_key(msg: StellarMessage) -> bytes:
+        return sha256(msg.to_xdr())
+
+    def clear_below(self, current_ledger: int) -> None:
+        """Drop records older than the previous ledger (Floodgate.cpp:46)."""
+        keep = current_ledger - 1
+        for k in [k for k, r in self.flood_map.items() if r.ledger_seq < keep]:
+            del self.flood_map[k]
+        self.m_added.set_count(len(self.flood_map))
+
+    def add_record(self, msg: StellarMessage, from_peer) -> bool:
+        """Returns True if the message is NEW (should be processed/forwarded)."""
+        if self._shutting_down:
+            return False
+        key = self.message_key(msg)
+        rec = self.flood_map.get(key)
+        if rec is None:
+            lm = self.app.ledger_manager
+            seq = lm.get_ledger_num() if lm.last_closed is not None else 0
+            rec = FloodRecord(seq, msg)
+            self.flood_map[key] = rec
+            self.m_added.set_count(len(self.flood_map))
+            if from_peer is not None:
+                rec.peers_told.add(from_peer)
+            return True
+        if from_peer is not None:
+            rec.peers_told.add(from_peer)
+        return False
+
+    def broadcast(self, msg: StellarMessage, force: bool) -> None:
+        """Send to every authenticated peer that hasn't seen it yet
+        (Floodgate.cpp:84-110).  A missing record means the message
+        originated locally — create one and flood.  ``force`` re-floods even
+        when the record exists (SCP rebroadcast)."""
+        if self._shutting_down:
+            return
+        key = self.message_key(msg)
+        rec = self.flood_map.get(key)
+        if rec is None:
+            lm = self.app.ledger_manager
+            seq = lm.get_ledger_num() if lm.last_closed is not None else 0
+            rec = FloodRecord(seq, msg)
+            self.flood_map[key] = rec
+            self.m_added.set_count(len(self.flood_map))
+        elif not force:
+            return
+        om = self.app.overlay_manager
+        for peer in list(om.authenticated_peers()):
+            if peer not in rec.peers_told:
+                rec.peers_told.add(peer)
+                peer.send_message(msg)
+
+    def shutdown(self) -> None:
+        self._shutting_down = True
+        self.flood_map.clear()

@@ -1,0 +1,128 @@
+"""ItemFetcher — anycast fetch of txsets / quorum sets by hash
+(reference: src/overlay/ItemFetcher.{h,cpp}).
+
+One Tracker per outstanding hash: ask one peer (preferring whoever sent the
+envelope that needs the item), and on DONT_HAVE or timeout move to the next
+authenticated peer, looping forever until ``recv`` or ``stop_fetch``.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Callable, Dict, List, Optional
+
+from ..util import VirtualTimer, xlog
+from ..xdr.overlay import MessageType, StellarMessage
+from ..xdr.scp import SCPEnvelope
+
+log = xlog.logger("Overlay")
+
+MS_TO_WAIT_FOR_FETCH_REPLY = 1.5  # seconds (ItemFetcher.cpp:17 — 1500ms)
+
+
+class Tracker:
+    def __init__(self, app, item_hash: bytes, ask_peer: Callable):
+        self.app = app
+        self.item_hash = item_hash
+        self.ask_peer = ask_peer  # fn(peer, hash) -> sends the GET_* message
+        self.last_asked_peer = None
+        self.peers_asked: List[object] = []
+        self.timer = VirtualTimer(app.clock)
+        self.envelopes: List[SCPEnvelope] = []
+        self.num_list_rebuild = 0
+
+    def listen(self, envelope: SCPEnvelope) -> None:
+        self.envelopes.append(envelope)
+
+    def pop(self) -> Optional[SCPEnvelope]:
+        if self.envelopes:
+            return self.envelopes.pop(0)
+        return None
+
+    def cancel(self) -> None:
+        self.timer.cancel()
+        self.last_asked_peer = None
+
+    def try_next_peer(self) -> None:
+        """Ask the next candidate peer (ItemFetcher.cpp tryNextPeer): first
+        whoever sent an envelope needing this item, then random others."""
+        om = self.app.overlay_manager
+        if om is None:
+            return
+        peers = [p for p in om.authenticated_peers()]
+        if not peers:
+            # retry once peers exist
+            self.timer.expires_from_now(MS_TO_WAIT_FOR_FETCH_REPLY)
+            self.timer.async_wait(self.try_next_peer)
+            return
+        candidate = None
+        # prefer senders of waiting envelopes we haven't asked yet
+        sender_ids = {
+            e.statement.nodeID.value
+            for e in self.envelopes
+            if e.statement.nodeID is not None
+        }
+        fresh = [p for p in peers if p not in self.peers_asked]
+        for p in fresh:
+            if p.peer_id is not None and p.peer_id.value in sender_ids:
+                candidate = p
+                break
+        if candidate is None and fresh:
+            candidate = random.choice(fresh)
+        if candidate is None:
+            # exhausted everyone: rebuild the ask list and start over
+            self.peers_asked.clear()
+            self.num_list_rebuild += 1
+            candidate = random.choice(peers)
+        self.peers_asked.append(candidate)
+        self.last_asked_peer = candidate
+        self.ask_peer(candidate, self.item_hash)
+        self.timer.expires_from_now(MS_TO_WAIT_FOR_FETCH_REPLY)
+        self.timer.async_wait(self.try_next_peer)
+
+    def doesnt_have(self, peer) -> None:
+        if self.last_asked_peer is peer:
+            self.try_next_peer()
+
+
+class ItemFetcher:
+    def __init__(self, app, ask_peer: Callable):
+        self.app = app
+        self.ask_peer = ask_peer
+        self.trackers: Dict[bytes, Tracker] = {}
+
+    def fetch(self, item_hash: bytes, envelope: SCPEnvelope) -> None:
+        tr = self.trackers.get(item_hash)
+        if tr is None:
+            tr = Tracker(self.app, item_hash, self.ask_peer)
+            self.trackers[item_hash] = tr
+            tr.listen(envelope)
+            tr.try_next_peer()
+        else:
+            tr.listen(envelope)
+
+    def recv(self, item_hash: bytes) -> None:
+        tr = self.trackers.pop(item_hash, None)
+        if tr is not None:
+            tr.cancel()
+
+    def stop_fetch(self, item_hash: bytes) -> None:
+        self.recv(item_hash)
+
+    def stop_fetching_below(self, slot_index: int) -> None:
+        """Drop trackers only needed by slots below `slot_index`."""
+        for h, tr in list(self.trackers.items()):
+            tr.envelopes = [
+                e for e in tr.envelopes if e.statement.slotIndex >= slot_index
+            ]
+            if not tr.envelopes:
+                tr.cancel()
+                del self.trackers[h]
+
+    def doesnt_have(self, item_hash: bytes, peer) -> None:
+        tr = self.trackers.get(item_hash)
+        if tr is not None:
+            tr.doesnt_have(peer)
+
+    def __len__(self) -> int:
+        return len(self.trackers)

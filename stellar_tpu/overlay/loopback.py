@@ -1,0 +1,164 @@
+"""LoopbackPeer — in-process peer pair for tests and simulation
+(reference: src/overlay/LoopbackPeer.{h,cpp}).
+
+A pair of Peers whose transports are each other's in-memory queues, with
+fault injection: per-message drop / duplicate / reorder / byte-damage
+probabilities, cork control, and queue bounding — the byzantine test rig
+(LoopbackPeer.h:24-100).  Delivery is explicit (``deliver_one`` /
+``deliver_all``) or scheduled on the clock, so tests and the Simulation can
+crank message-by-message deterministically.
+"""
+
+from __future__ import annotations
+
+import random
+from collections import deque
+from typing import Deque, Optional
+
+from ..util import xlog
+from ..xdr.overlay import MessageType
+from .peer import Peer, PeerRole
+
+log = xlog.logger("Overlay")
+
+MAX_QUEUE_DEPTH = 1000
+
+
+class LoopbackPeer(Peer):
+    def __init__(self, app, role: str):
+        super().__init__(app, role)
+        self.remote: Optional["LoopbackPeer"] = None
+        self.out_queue: Deque[bytes] = deque()
+        self.corked = False
+        self.max_queue_depth = MAX_QUEUE_DEPTH
+        # fault injection (LoopbackPeer.h:36-41)
+        self.damage_prob = 0.0
+        self.drop_prob = 0.0
+        self.duplicate_prob = 0.0
+        self.reorder_prob = 0.0
+        self.damage_cert = False
+        self.damage_auth = False
+        self._rng = random.Random()
+        self._closed = False
+
+    # -- transport ----------------------------------------------------------
+    def send_frame(self, data: bytes) -> None:
+        if self._closed or self.remote is None:
+            return
+        self.out_queue.append(data)
+        while len(self.out_queue) > self.max_queue_depth:
+            self.out_queue.popleft()  # shed oldest (queue-bounded transport)
+        if not self.corked:
+            self._schedule_delivery()
+
+    def close_transport(self) -> None:
+        self._closed = True
+        remote = self.remote
+        if remote is not None and not remote._closed:
+            # async close notification, as a socket EOF would be
+            self.app.clock.post(lambda: remote.drop())
+
+    def ip(self) -> str:
+        return "127.0.0.1"
+
+    # -- explicit delivery (tests) ------------------------------------------
+    def deliver_one(self) -> bool:
+        """Move one queued frame into the remote peer, applying faults."""
+        if self.remote is None or not self.out_queue:
+            return False
+        data = self.out_queue.popleft()
+
+        if self.drop_prob > 0 and self._rng.random() < self.drop_prob:
+            log.debug("loopback dropping frame")
+            return True
+        if self.duplicate_prob > 0 and self._rng.random() < self.duplicate_prob:
+            log.debug("loopback duplicating frame")
+            self.out_queue.appendleft(data)
+        if self.reorder_prob > 0 and len(self.out_queue) > 0 and (
+            self._rng.random() < self.reorder_prob
+        ):
+            log.debug("loopback reordering frame")
+            self.out_queue.append(data)
+            return True
+        if self.damage_prob > 0 and self._rng.random() < self.damage_prob:
+            log.debug("loopback damaging frame")
+            data = self._flip_random_byte(data)
+        # targeted handshake damage (LoopbackPeer.h:83-100), applied at
+        # delivery so tests can arm the knobs after the connection starts
+        mt = self._frame_msg_type(data)
+        if self.damage_cert and mt == MessageType.HELLO2:
+            data = self._damage_hello2_cert(data)
+        if self.damage_auth and mt == MessageType.AUTH:
+            data = self._flip_random_byte(data)
+
+        remote = self.remote
+        if remote is not None and not remote._closed:
+            remote.recv_frame(data)
+        return True
+
+    def deliver_all(self) -> None:
+        while self.deliver_one():
+            pass
+
+    def drop_all(self) -> None:
+        self.out_queue.clear()
+
+    def _schedule_delivery(self) -> None:
+        self.app.clock.post(self._pump)
+
+    def _pump(self) -> None:
+        if not self.corked:
+            self.deliver_all()
+
+    def set_corked(self, corked: bool) -> None:
+        self.corked = corked
+        if not corked:
+            self._schedule_delivery()
+
+    @staticmethod
+    def _damage_hello2_cert(data: bytes) -> bytes:
+        """Corrupt the auth-cert signature inside a HELLO2 frame."""
+        from ..xdr.overlay import AuthenticatedMessage
+
+        try:
+            amsg = AuthenticatedMessage.from_xdr(data)
+            cert = amsg.value.message.value.cert
+            sig = bytearray(cert.sig)
+            sig[0] ^= 0x01
+            cert.sig = bytes(sig)
+            return amsg.to_xdr()
+        except Exception:
+            return data
+
+    @staticmethod
+    def _frame_msg_type(data: bytes):
+        """StellarMessage type inside an XDR AuthenticatedMessage frame:
+        union disc (4) + sequence (8) + message type (4)."""
+        if len(data) < 16:
+            return None
+        try:
+            return MessageType(int.from_bytes(data[12:16], "big"))
+        except ValueError:
+            return None
+
+    def _flip_random_byte(self, data: bytes) -> bytes:
+        if not data:
+            return data
+        i = self._rng.randrange(len(data))
+        b = bytearray(data)
+        b[i] ^= 1 << self._rng.randrange(8)
+        return bytes(b)
+
+
+class LoopbackPeerConnection:
+    """Wires an initiator/acceptor LoopbackPeer pair between two apps and
+    kicks off the handshake (LoopbackPeer.cpp LoopbackPeerConnection)."""
+
+    def __init__(self, initiator_app, acceptor_app):
+        self.initiator = LoopbackPeer(initiator_app, PeerRole.WE_CALLED_REMOTE)
+        self.acceptor = LoopbackPeer(acceptor_app, PeerRole.REMOTE_CALLED_US)
+        self.initiator.remote = self.acceptor
+        self.acceptor.remote = self.initiator
+        initiator_app.overlay_manager.add_pending_peer(self.initiator)
+        acceptor_app.overlay_manager.add_pending_peer(self.acceptor)
+        self.initiator.connect_handler()

@@ -1,0 +1,187 @@
+"""OverlayManager — peer lifecycle + flood routing
+(reference: src/overlay/OverlayManagerImpl.{h,cpp}).
+
+Every 2 seconds ``tick`` tops the connection count up toward
+TARGET_PEER_CONNECTIONS: preferred peers first, then the SQL peer address
+book ordered by next-attempt backoff (OverlayManagerImpl.cpp:215-260).
+Flooded messages (transactions, SCP envelopes) pass through the Floodgate
+for at-most-once semantics; tx-set / quorum-set fetch rides the two
+ItemFetchers' anycast ask-one-peer loops.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..util import VirtualTimer, xlog
+from ..xdr.overlay import MessageType, StellarMessage
+from .floodgate import Floodgate
+from .itemfetcher import ItemFetcher
+from .peer import Peer, PeerRole, PeerState
+from .peerauth import PeerAuth
+from .peerrecord import PeerRecord
+
+log = xlog.logger("Overlay")
+
+TICK_SECONDS = 2.0
+
+
+class OverlayManager:
+    def __init__(self, app):
+        self.app = app
+        self.peer_auth = PeerAuth(app)
+        self.floodgate = Floodgate(app)
+        self.peers: List[Peer] = []  # pending + authenticated
+        self.door = None
+        self.tick_timer = VirtualTimer(app.clock)
+        self._shutting_down = False
+        self.tx_set_fetcher = ItemFetcher(app, lambda p, h: p.send_get_tx_set(h))
+        self.qset_fetcher = ItemFetcher(app, lambda p, h: p.send_get_quorum_set(h))
+        self.m_connections = app.metrics.new_counter(("overlay", "connection", "count"))
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> None:
+        from .tcppeer import PeerDoor
+
+        self.store_config_peers()
+        if self.door is None:
+            self.door = PeerDoor(self.app)
+            try:
+                self.door.start()
+            except OSError as e:
+                log.warning("could not listen on peer port: %s", e)
+                self.door = None
+        self.tick()
+
+    def shutdown(self) -> None:
+        if self._shutting_down:
+            return
+        self._shutting_down = True
+        self.tick_timer.cancel()
+        if self.door is not None:
+            self.door.close()
+        self.floodgate.shutdown()
+        for p in list(self.peers):
+            p.drop()
+        self.peers.clear()
+
+    def is_shutting_down(self) -> bool:
+        return self._shutting_down
+
+    # -- connection management ----------------------------------------------
+    def store_config_peers(self) -> None:
+        """Seed the address book from config (OverlayManagerImpl::storeConfigPeers)."""
+        cfg = self.app.config
+        for s in cfg.PREFERRED_PEERS + cfg.KNOWN_PEERS:
+            try:
+                pr = PeerRecord.parse_ip_port(s, cfg.PEER_PORT)
+            except ValueError:
+                log.warning("bad peer address in config: %r", s)
+                continue
+            pr.store(self.app.database)
+
+    def tick(self) -> None:
+        """Top up outbound connections (OverlayManagerImpl.cpp:215)."""
+        if self._shutting_down:
+            return
+        cfg = self.app.config
+        need = cfg.TARGET_PEER_CONNECTIONS - len(self.peers)
+        if need > 0:
+            connected = {(p.ip(), p.remote_listening_port) for p in self.peers}
+            for pr in PeerRecord.load_peers(
+                self.app.database, need, self.app.clock.now()
+            ):
+                if (pr.ip, pr.port) in connected:
+                    continue
+                self.connect_to(pr)
+        self.tick_timer.expires_from_now(TICK_SECONDS)
+        self.tick_timer.async_wait(self.tick)
+
+    def connect_to(self, pr: PeerRecord) -> None:
+        from .tcppeer import TCPPeer
+
+        if len(self.peers) >= self.app.config.MAX_PEER_CONNECTIONS:
+            return
+        pr.back_off(self.app.database, self.app.clock.now())
+        peer = TCPPeer.initiate(self.app, pr.ip, pr.port)
+        if peer.state != PeerState.CLOSING:
+            self.peers.append(peer)
+            self.m_connections.set_count(len(self.peers))
+
+    def add_pending_peer(self, peer: Peer) -> None:
+        if self._shutting_down or len(self.peers) >= self.app.config.MAX_PEER_CONNECTIONS:
+            peer.drop()
+            return
+        self.peers.append(peer)
+        self.m_connections.set_count(len(self.peers))
+
+    def accept_authenticated_peer(self, peer: Peer) -> bool:
+        """Post-handshake admission (OverlayManagerImpl::isPeerAccepted):
+        room check + preferred-peers-only policy; successful auth resets the
+        address-book backoff."""
+        cfg = self.app.config
+        if cfg.PREFERRED_PEERS_ONLY and not self.is_preferred(peer):
+            return False
+        n_auth = len(self.authenticated_peers())
+        if n_auth > cfg.MAX_PEER_CONNECTIONS:
+            return self.is_preferred(peer)
+        if peer.remote_listening_port:
+            pr = PeerRecord(peer.ip(), peer.remote_listening_port)
+            pr.store(self.app.database)
+            pr.reset_back_off(self.app.database, self.app.clock.now())
+        return True
+
+    def is_preferred(self, peer: Peer) -> bool:
+        cfg = self.app.config
+        addr = f"{peer.ip()}:{peer.remote_listening_port}"
+        if addr in cfg.PREFERRED_PEERS:
+            return True
+        if peer.peer_id is not None:
+            from ..crypto.keys import PubKeyUtils
+
+            if PubKeyUtils.to_strkey(peer.peer_id) in cfg.PREFERRED_PEER_KEYS:
+                return True
+        return False
+
+    def drop_peer(self, peer: Peer) -> None:
+        if peer in self.peers:
+            self.peers.remove(peer)
+            self.m_connections.set_count(len(self.peers))
+
+    # -- views --------------------------------------------------------------
+    def get_peers(self) -> List[Peer]:
+        return list(self.peers)
+
+    def authenticated_peers(self) -> List[Peer]:
+        return [p for p in self.peers if p.is_authenticated()]
+
+    def get_authenticated_peer_count(self) -> int:
+        return len(self.authenticated_peers())
+
+    # -- flooding -----------------------------------------------------------
+    def recv_flooded_msg(self, msg: StellarMessage, peer: Peer) -> bool:
+        """Record a flooded message arrival; False if already seen."""
+        return self.floodgate.add_record(msg, peer)
+
+    def broadcast_message(self, msg: StellarMessage, force: bool = False) -> None:
+        self.floodgate.broadcast(msg, force)
+
+    def ledger_closed(self, ledger_seq: int) -> None:
+        self.floodgate.clear_below(ledger_seq)
+        self.tx_set_fetcher.stop_fetching_below(ledger_seq + 1)
+        self.qset_fetcher.stop_fetching_below(ledger_seq + 1)
+
+    def dump_info(self) -> dict:
+        return {
+            "peers": [
+                {
+                    "ip": p.ip(),
+                    "port": p.remote_listening_port,
+                    "ver": p.remote_version,
+                    "auth": p.is_authenticated(),
+                    "id": None if p.peer_id is None else p.peer_id.value.hex()[:8],
+                }
+                for p in self.peers
+            ],
+            "authenticated_count": self.get_authenticated_peer_count(),
+        }

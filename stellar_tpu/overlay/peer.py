@@ -1,0 +1,420 @@
+"""Peer — the per-connection protocol state machine
+(reference: src/overlay/Peer.{h,cpp}).
+
+Handshake (HELLO2 path, Peer.cpp:949-1005): initiator sends HELLO2 with its
+auth cert + nonce; acceptor verifies the cert, derives per-direction
+HMAC-SHA256 keys from ECDH(cert ephemerals) + both nonces, replies HELLO2;
+initiator does the same and sends AUTH; acceptor replies AUTH.  Every frame
+after HELLO2 carries a strictly-increasing sequence number and an HMAC over
+``xdr(seq ‖ msg)`` (Peer.cpp:461-464, verified at :524-543); any mismatch
+drops the connection — transport-level tamper evidence on top of the
+per-message ed25519 signatures.
+
+TPU note: inbound SCP envelopes are pre-warmed through the app's SigBackend
+(one batched verify populating the shared cache) before being handed to the
+Herder, so the Herder's eager per-envelope check is a cache hit.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..crypto import sha256
+from ..crypto.sha import hmac_sha256, hmac_sha256_verify
+from ..crypto.sodium import randombytes
+from ..util import xlog
+from ..xdr.base import uint64, xdr_to_opaque
+from ..xdr.overlay import (
+    Auth,
+    AuthCert,
+    AuthenticatedMessage,
+    DontHave,
+    Error,
+    ErrorCode,
+    Hello2,
+    MessageType,
+    PeerAddress,
+    PeerAddressIp,
+    IPAddrType,
+    StellarMessage,
+)
+from ..xdr.scp import SCPEnvelope
+from ..xdr.xtypes import HmacSha256Mac, PublicKey
+
+log = xlog.logger("Overlay")
+
+
+class PeerRole:
+    WE_CALLED_REMOTE = "WE_CALLED_REMOTE"
+    REMOTE_CALLED_US = "REMOTE_CALLED_US"
+
+
+class PeerState:
+    CONNECTING = 0
+    CONNECTED = 1
+    GOT_HELLO = 2
+    GOT_AUTH = 3
+    CLOSING = 4
+
+
+# message types exempt from MAC/sequence (sent before keys exist)
+_UNMACED = (MessageType.HELLO2, MessageType.ERROR_MSG)
+
+
+class Peer:
+    def __init__(self, app, role: str):
+        self.app = app
+        self.role = role
+        self.state = (
+            PeerState.CONNECTING
+            if role == PeerRole.WE_CALLED_REMOTE
+            else PeerState.CONNECTED
+        )
+        self.peer_id: Optional[PublicKey] = None
+        self.remote_version = ""
+        self.remote_overlay_version = 0
+        self.remote_listening_port = 0
+        self.send_nonce = randombytes(32)
+        self.recv_nonce = b""
+        self.send_mac_key = b""
+        self.recv_mac_key = b""
+        self.send_mac_seq = 0
+        self.recv_mac_seq = 0
+        self._m_drop = app.metrics.new_meter(("overlay", "drop", "count"), "drop")
+        self._m_recv = app.metrics.new_meter(("overlay", "message", "read"), "message")
+        self._m_sent = app.metrics.new_meter(("overlay", "message", "write"), "message")
+
+    # -- abstract transport -------------------------------------------------
+    def send_frame(self, data: bytes) -> None:
+        raise NotImplementedError
+
+    def close_transport(self) -> None:
+        raise NotImplementedError
+
+    def ip(self) -> str:
+        return ""
+
+    # -- identity -----------------------------------------------------------
+    def is_connected(self) -> bool:
+        return self.state not in (PeerState.CONNECTING, PeerState.CLOSING)
+
+    def is_authenticated(self) -> bool:
+        return self.state == PeerState.GOT_AUTH
+
+    def should_abort(self) -> bool:
+        om = self.app.overlay_manager
+        return self.state == PeerState.CLOSING or (
+            om is not None and om.is_shutting_down()
+        )
+
+    def __repr__(self):
+        pid = "?" if self.peer_id is None else self.peer_id.value[:4].hex()
+        return f"<Peer {self.role[:2]} {pid} s={self.state}>"
+
+    # -- outbound -----------------------------------------------------------
+    def connect_handler(self) -> None:
+        """Transport established (TCPPeer::connectHandler): say hello."""
+        self.state = PeerState.CONNECTED
+        self.send_hello2()
+
+    def send_hello2(self) -> None:
+        cfg = self.app.config
+        om = self.app.overlay_manager
+        msg = StellarMessage(
+            MessageType.HELLO2,
+            Hello2(
+                ledgerVersion=cfg.LEDGER_PROTOCOL_VERSION,
+                overlayVersion=cfg.OVERLAY_PROTOCOL_VERSION,
+                overlayMinVersion=cfg.OVERLAY_PROTOCOL_MIN_VERSION,
+                networkID=self.app.network_id,
+                versionStr=cfg.VERSION_STR,
+                listeningPort=cfg.PEER_PORT,
+                peerID=cfg.NODE_SEED.get_public_key(),
+                cert=om.peer_auth.get_auth_cert(),
+                nonce=self.send_nonce,
+            ),
+        )
+        self.send_message(msg)
+
+    def send_auth(self) -> None:
+        self.send_message(StellarMessage(MessageType.AUTH, Auth(0)))
+
+    def send_error(self, code: ErrorCode, text: str) -> None:
+        self.send_message(StellarMessage(MessageType.ERROR_MSG, Error(code, text)))
+
+    def send_dont_have(self, msg_type: MessageType, item_hash: bytes) -> None:
+        self.send_message(
+            StellarMessage(MessageType.DONT_HAVE, DontHave(msg_type, item_hash))
+        )
+
+    def send_get_tx_set(self, h: bytes) -> None:
+        self.send_message(StellarMessage(MessageType.GET_TX_SET, h))
+
+    def send_get_quorum_set(self, h: bytes) -> None:
+        self.send_message(StellarMessage(MessageType.GET_SCP_QUORUMSET, h))
+
+    def send_get_peers(self) -> None:
+        self.send_message(StellarMessage(MessageType.GET_PEERS, None))
+
+    def send_peers(self) -> None:
+        from .peerrecord import PeerRecord
+
+        addrs: List[PeerAddress] = []
+        for pr in PeerRecord.load_peers(self.app.database, 50, self.app.clock.now() + 3600):
+            try:
+                parts = bytes(int(x) for x in pr.ip.split("."))
+            except ValueError:
+                continue
+            if len(parts) != 4:
+                continue
+            addrs.append(
+                PeerAddress(
+                    PeerAddressIp(IPAddrType.IPv4, parts), pr.port, pr.num_failures
+                )
+            )
+        self.send_message(StellarMessage(MessageType.PEERS, addrs))
+
+    def send_message(self, msg: StellarMessage) -> None:
+        """Wrap in AuthenticatedMessage (MAC + seq unless handshake/error)
+        and hand to the transport (Peer::sendMessage, Peer.cpp:457-467)."""
+        if self.should_abort() and msg.type != MessageType.ERROR_MSG:
+            return
+        if msg.type in _UNMACED:
+            amsg = AuthenticatedMessage.v0_of(0, msg, b"\x00" * 32)
+        else:
+            seq = self.send_mac_seq
+            mac = hmac_sha256(self.send_mac_key, xdr_to_opaque((uint64, seq), msg))
+            self.send_mac_seq += 1
+            amsg = AuthenticatedMessage.v0_of(seq, msg, mac)
+        self._m_sent.mark()
+        self.send_frame(amsg.to_xdr())
+
+    # -- inbound ------------------------------------------------------------
+    def recv_frame(self, data: bytes) -> None:
+        try:
+            amsg = AuthenticatedMessage.from_xdr(data)
+        except Exception as e:
+            log.warning("bad frame from %r: %s", self, e)
+            self.drop()
+            return
+        self.recv_authenticated_message(amsg)
+
+    def recv_authenticated_message(self, amsg: AuthenticatedMessage) -> None:
+        """Sequence + MAC check once keys exist (Peer.cpp:522-543)."""
+        v0 = amsg.value
+        msg = v0.message
+        if self.state >= PeerState.GOT_HELLO and msg.type != MessageType.ERROR_MSG:
+            if v0.sequence != self.recv_mac_seq:
+                log.warning("unexpected auth sequence from %r", self)
+                self.drop(ErrorCode.ERR_AUTH, "unexpected auth sequence")
+                return
+            if not hmac_sha256_verify(
+                v0.mac.mac, self.recv_mac_key, xdr_to_opaque((uint64, v0.sequence), msg)
+            ):
+                log.warning("MAC failed on recv from %r", self)
+                self.drop(ErrorCode.ERR_AUTH, "unexpected MAC")
+                return
+            self.recv_mac_seq += 1
+        self.recv_message(msg)
+
+    def recv_message(self, msg: StellarMessage) -> None:
+        if self.should_abort():
+            return
+        self._m_recv.mark()
+        t = msg.type
+        if not self.is_authenticated() and t not in (
+            MessageType.HELLO2,
+            MessageType.AUTH,
+            MessageType.ERROR_MSG,
+        ):
+            log.warning("recv %s before handshake from %r", t.name, self)
+            self.drop()
+            return
+        handler = {
+            MessageType.ERROR_MSG: self.recv_error,
+            MessageType.HELLO2: self.recv_hello2,
+            MessageType.AUTH: self.recv_auth,
+            MessageType.DONT_HAVE: self.recv_dont_have,
+            MessageType.GET_PEERS: self.recv_get_peers,
+            MessageType.PEERS: self.recv_peers,
+            MessageType.GET_TX_SET: self.recv_get_tx_set,
+            MessageType.TX_SET: self.recv_tx_set,
+            MessageType.TRANSACTION: self.recv_transaction,
+            MessageType.GET_SCP_QUORUMSET: self.recv_get_scp_quorum_set,
+            MessageType.SCP_QUORUMSET: self.recv_scp_quorum_set,
+            MessageType.SCP_MESSAGE: self.recv_scp_message,
+            MessageType.GET_SCP_STATE: self.recv_get_scp_state,
+        }.get(t)
+        if handler is None:
+            log.warning("unhandled message type %s from %r", t, self)
+            return
+        handler(msg)
+
+    # -- handshake handlers -------------------------------------------------
+    def recv_hello2(self, msg: StellarMessage) -> None:
+        elo: Hello2 = msg.value
+        om = self.app.overlay_manager
+        if self.state >= PeerState.GOT_HELLO:
+            log.warning("unexpected HELLO2 from %r", self)
+            self.drop()
+            return
+        if not om.peer_auth.verify_remote_auth_cert(elo.peerID, elo.cert):
+            log.warning("bad auth cert from %r", self)
+            self.drop()
+            return
+        if elo.peerID == self.app.config.NODE_SEED.get_public_key():
+            self.drop(ErrorCode.ERR_CONF, "connecting to self")
+            return
+        if elo.networkID != self.app.network_id:
+            self.drop(ErrorCode.ERR_CONF, "wrong network passphrase")
+            return
+        if not (0 < elo.listeningPort <= 65535):
+            self.drop(ErrorCode.ERR_CONF, "bad port number")
+            return
+        for p in om.get_peers():
+            if p is not self and p.peer_id == elo.peerID:
+                self.drop(ErrorCode.ERR_CONF, "already connected")
+                return
+        if (
+            elo.overlayMinVersion > self.app.config.OVERLAY_PROTOCOL_VERSION
+            or elo.overlayVersion < self.app.config.OVERLAY_PROTOCOL_MIN_VERSION
+        ):
+            self.drop(ErrorCode.ERR_CONF, "wrong protocol version")
+            return
+        self.peer_id = elo.peerID
+        self.remote_version = elo.versionStr
+        self.remote_overlay_version = elo.overlayVersion
+        self.remote_listening_port = elo.listeningPort
+        self.recv_nonce = elo.nonce
+        we_called = self.role == PeerRole.WE_CALLED_REMOTE
+        self.send_mac_seq = 0
+        self.recv_mac_seq = 0
+        self.send_mac_key = om.peer_auth.get_sending_mac_key(
+            self.send_nonce, self.recv_nonce, elo.cert.pubkey.key, we_called
+        )
+        self.recv_mac_key = om.peer_auth.get_receiving_mac_key(
+            self.send_nonce, self.recv_nonce, elo.cert.pubkey.key, we_called
+        )
+        self.state = PeerState.GOT_HELLO
+        if we_called:
+            self.send_auth()
+        else:
+            self.send_hello2()
+
+    def recv_auth(self, msg: StellarMessage) -> None:
+        if self.state != PeerState.GOT_HELLO:
+            self.drop(ErrorCode.ERR_MISC, "out-of-order AUTH")
+            return
+        self.state = PeerState.GOT_AUTH
+        if self.role == PeerRole.REMOTE_CALLED_US:
+            self.send_auth()
+        om = self.app.overlay_manager
+        if not om.accept_authenticated_peer(self):
+            self.drop(ErrorCode.ERR_LOAD, "peer rejected")
+            return
+        # learn more of the network + pull the peer's SCP state
+        self.send_get_peers()
+        if self.app.herder is not None:
+            self.send_message(
+                StellarMessage(
+                    MessageType.GET_SCP_STATE,
+                    max(0, self.app.ledger_manager.get_ledger_num() - 1),
+                )
+            )
+
+    def recv_error(self, msg: StellarMessage) -> None:
+        err: Error = msg.value
+        log.warning("peer %r sent error %s: %s", self, err.code, err.msg)
+        self.drop()
+
+    # -- item handlers ------------------------------------------------------
+    def recv_dont_have(self, msg: StellarMessage) -> None:
+        dh: DontHave = msg.value
+        self.app.herder.peer_doesnt_have(dh.type, dh.reqHash, self)
+
+    def recv_get_peers(self, msg: StellarMessage) -> None:
+        self.send_peers()
+
+    def recv_peers(self, msg: StellarMessage) -> None:
+        from .peerrecord import PeerRecord
+
+        for addr in msg.value:
+            if addr.ip.type != IPAddrType.IPv4:
+                continue
+            if not (0 < addr.port <= 65535):
+                continue  # remote-supplied; don't let bad data near the DB
+            ip = ".".join(str(b) for b in addr.ip.value)
+            try:
+                pr = PeerRecord(ip, addr.port, self.app.clock.now(), addr.numFailures)
+                pr.store(self.app.database)
+            except Exception as e:
+                log.warning("could not store peer %s:%d: %s", ip, addr.port, e)
+
+    def recv_get_tx_set(self, msg: StellarMessage) -> None:
+        ts = self.app.herder.get_tx_set(msg.value)
+        if ts is not None:
+            self.send_message(StellarMessage(MessageType.TX_SET, ts.to_xdr()))
+        else:
+            self.send_dont_have(MessageType.TX_SET, msg.value)
+
+    def recv_tx_set(self, msg: StellarMessage) -> None:
+        from ..herder.txset import TxSetFrame
+
+        frame = TxSetFrame.from_xdr_set(self.app.network_id, msg.value)
+        self.app.herder.recv_tx_set(frame.get_contents_hash(), frame)
+
+    def recv_transaction(self, msg: StellarMessage) -> None:
+        from ..tx.frame import TransactionFrame
+        from ..herder.herder import TX_STATUS_PENDING
+
+        om = self.app.overlay_manager
+        if not om.recv_flooded_msg(msg, self):
+            return  # duplicate
+        tx = TransactionFrame.make_from_wire(self.app.network_id, msg.value)
+        if self.app.herder.recv_transaction(tx) == TX_STATUS_PENDING:
+            om.broadcast_message(msg)
+
+    def recv_get_scp_quorum_set(self, msg: StellarMessage) -> None:
+        qset = self.app.herder.get_qset(msg.value)
+        if qset is not None:
+            self.send_message(StellarMessage(MessageType.SCP_QUORUMSET, qset))
+        else:
+            self.send_dont_have(MessageType.SCP_QUORUMSET, msg.value)
+
+    def recv_scp_quorum_set(self, msg: StellarMessage) -> None:
+        from ..scp.quorum import qset_hash
+
+        self.app.herder.recv_scp_quorum_set(qset_hash(msg.value), msg.value)
+
+    def recv_scp_message(self, msg: StellarMessage) -> None:
+        om = self.app.overlay_manager
+        if not om.recv_flooded_msg(msg, self):
+            return  # already seen
+        envelope: SCPEnvelope = msg.value
+        # TPU pre-warm: run the ed25519 check through the batch backend so
+        # the Herder's eager verify is a cache hit (SURVEY §7 flush points)
+        try:
+            triple = self.app.herder.envelope_verify_triple(envelope)
+            self.app.sig_backend.verify_batch([triple])
+        except Exception:
+            pass
+        self.app.herder.recv_scp_envelope(envelope)
+
+    def recv_get_scp_state(self, msg: StellarMessage) -> None:
+        self.app.herder.send_scp_state_to_peer(msg.value, self)
+
+    # -- teardown -----------------------------------------------------------
+    def drop(self, code: Optional[ErrorCode] = None, text: str = "") -> None:
+        if self.state == PeerState.CLOSING:
+            return
+        if code is not None:
+            try:
+                self.send_error(code, text)
+            except Exception:
+                pass
+        self.state = PeerState.CLOSING
+        self._m_drop.mark()
+        om = self.app.overlay_manager
+        if om is not None:
+            om.drop_peer(self)
+        self.close_transport()

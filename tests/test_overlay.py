@@ -1,0 +1,267 @@
+"""Overlay tests (reference: src/overlay/OverlayTests.cpp, FloodTests.cpp,
+ItemFetcherTests.cpp).
+
+LoopbackPeer pairs over a shared VirtualClock: handshake success/failure,
+fault injection (damaged certs, damaged MACs), flood dedup, anycast fetch.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from stellar_tpu.herder import TX_STATUS_PENDING
+from stellar_tpu.main.application import Application
+from stellar_tpu.overlay import (
+    Floodgate,
+    LoopbackPeer,
+    LoopbackPeerConnection,
+    PeerRole,
+    PeerState,
+)
+from stellar_tpu.tx import testutils as T
+from stellar_tpu.util import VirtualClock
+from stellar_tpu.xdr.overlay import MessageType, StellarMessage
+
+
+def make_app(clock, instance, manual_close=True):
+    cfg = T.get_test_config(instance)
+    cfg.MANUAL_CLOSE = manual_close
+    cfg.RUN_STANDALONE = True  # loopback only: no TCP door, no admin port
+    cfg.HTTP_PORT = 0
+    app = Application.create(clock, cfg, new_db=True)
+    app.start()
+    return app
+
+
+def crank(clock, n=80):
+    for _ in range(n):
+        clock.crank()
+
+
+@pytest.fixture
+def two_apps():
+    clock = VirtualClock()
+    a = make_app(clock, 0)
+    b = make_app(clock, 1)
+    yield clock, a, b
+    a.graceful_stop()
+    b.graceful_stop()
+
+
+# -- handshake -------------------------------------------------------------
+
+
+def test_loopback_handshake(two_apps):
+    clock, a, b = two_apps
+    conn = LoopbackPeerConnection(a, b)
+    crank(clock)
+    assert conn.initiator.is_authenticated()
+    assert conn.acceptor.is_authenticated()
+    assert a.overlay_manager.get_authenticated_peer_count() == 1
+    assert b.overlay_manager.get_authenticated_peer_count() == 1
+    # peers learned each other's identity
+    assert conn.initiator.peer_id == b.config.NODE_SEED.get_public_key()
+    assert conn.acceptor.peer_id == a.config.NODE_SEED.get_public_key()
+
+
+def test_handshake_rejects_wrong_network(two_apps):
+    clock, a, b = two_apps
+    b.network_id = b"\x01" * 32  # acceptor expects a different network
+    conn = LoopbackPeerConnection(a, b)
+    crank(clock)
+    assert not conn.initiator.is_authenticated()
+    assert not conn.acceptor.is_authenticated()
+
+
+def test_handshake_rejects_damaged_cert(two_apps):
+    """OverlayTests.cpp:151 'reject peers with bad certs'."""
+    clock, a, b = two_apps
+    conn = LoopbackPeerConnection(a, b)
+    conn.initiator.damage_cert = True
+    crank(clock)
+    assert not conn.initiator.is_authenticated()
+    assert not conn.acceptor.is_authenticated()
+
+
+def test_handshake_rejects_self_connection(two_apps):
+    clock, a, _ = two_apps
+    conn = LoopbackPeerConnection(a, a)
+    crank(clock)
+    assert not conn.initiator.is_authenticated()
+
+
+def test_mac_damage_drops_connection(two_apps):
+    """OverlayTests.cpp 'hmac damage' — tamper after auth, peer must drop."""
+    clock, a, b = two_apps
+    conn = LoopbackPeerConnection(a, b)
+    crank(clock)
+    assert conn.initiator.is_authenticated()
+    conn.initiator.damage_prob = 1.0
+    conn.initiator.send_get_peers()
+    crank(clock)
+    assert conn.acceptor.state == PeerState.CLOSING or not conn.acceptor.is_authenticated()
+
+
+def test_sequence_replay_detected(two_apps):
+    """Replaying a captured authenticated frame must kill the connection."""
+    clock, a, b = two_apps
+    conn = LoopbackPeerConnection(a, b)
+    crank(clock)
+    captured = []
+    orig = conn.initiator.send_frame
+
+    def capture(data):
+        captured.append(data)
+        orig(data)
+
+    conn.initiator.send_frame = capture
+    conn.initiator.send_get_peers()
+    crank(clock)
+    assert conn.acceptor.is_authenticated()
+    conn.acceptor.recv_frame(captured[0])  # replay
+    crank(clock)
+    assert not conn.acceptor.is_authenticated()
+
+
+# -- flooding --------------------------------------------------------------
+
+
+def test_floodgate_dedup(two_apps):
+    clock, a, _ = two_apps
+    fg = a.overlay_manager.floodgate
+    msg = StellarMessage(MessageType.GET_PEERS, None)
+    assert fg.add_record(msg, None) is True
+    assert fg.add_record(msg, None) is False  # duplicate
+    fg.clear_below(10)  # everything below ledger 9 gone
+    assert fg.add_record(msg, None) is True
+
+
+def test_transaction_floods_between_nodes():
+    """FloodTests.cpp 'FloodTests': a tx submitted on A reaches B's queue."""
+    clock = VirtualClock()
+    a = make_app(clock, 0)
+    b = make_app(clock, 1)
+    LoopbackPeerConnection(a, b)
+    crank(clock)
+
+    from stellar_tpu.ledger.accountframe import AccountFrame
+
+    root = T.root_key_for(a)
+    dest = T.get_account("flood-dest")
+    seq = AccountFrame.load_account(root.get_public_key(), a.database).get_seq_num()
+    tx = T.tx_from_ops(
+        a, root, seq + 1, [T.create_account_op(dest, 10_000_000_000)]
+    )
+    assert a.herder.recv_transaction(tx) == TX_STATUS_PENDING
+    a.overlay_manager.broadcast_message(tx.to_stellar_message(), force=True)
+    crank(clock)
+
+    acc = tx.get_source_id().value
+    assert any(
+        tx.get_full_hash() in m.transactions
+        for gen in b.herder.received_transactions
+        for k, m in gen.items()
+        if k == acc
+    )
+    a.graceful_stop()
+    b.graceful_stop()
+
+
+def test_get_peers_exchange(two_apps):
+    clock, a, b = two_apps
+    from stellar_tpu.overlay import PeerRecord
+
+    PeerRecord("10.1.2.3", 12345).store(b.database)
+    conn = LoopbackPeerConnection(a, b)
+    crank(clock)
+    conn.initiator.send_get_peers()
+    crank(clock)
+    assert PeerRecord.load(a.database, "10.1.2.3", 12345) is not None
+
+
+# -- item fetch ------------------------------------------------------------
+
+
+def test_item_fetcher_anycast(two_apps):
+    clock, a, b = two_apps
+    conn = LoopbackPeerConnection(a, b)
+    crank(clock)
+
+    asked = []
+    fetcher = a.overlay_manager.tx_set_fetcher
+    fetcher.ask_peer = lambda p, h: asked.append((p, h))
+    # tracker construction uses the fetcher's ask_peer at call time
+    from stellar_tpu.xdr.scp import SCPEnvelope, SCPStatement
+
+    env = SCPEnvelope()
+    env.statement = SCPStatement()
+    env.statement.slotIndex = 2
+    h = b"\x07" * 32
+    fetcher.fetch(h, env)
+    assert len(fetcher) == 1
+    assert asked and asked[0][1] == h
+    # a DONT_HAVE moves to another peer (here: same single peer again)
+    fetcher.doesnt_have(h, asked[0][0])
+    assert len(asked) >= 2
+    # receiving the item cancels the tracker
+    fetcher.recv(h)
+    assert len(fetcher) == 0
+
+
+def test_fetch_timeout_retries(two_apps):
+    clock, a, b = two_apps
+    conn = LoopbackPeerConnection(a, b)
+    crank(clock)
+
+    asked = []
+    fetcher = a.overlay_manager.qset_fetcher
+    fetcher.ask_peer = lambda p, h: asked.append(p)
+    from stellar_tpu.xdr.scp import SCPEnvelope, SCPStatement
+
+    env = SCPEnvelope()
+    env.statement = SCPStatement()
+    env.statement.slotIndex = 2
+    fetcher.fetch(b"\x09" * 32, env)
+    n0 = len(asked)
+    clock.crank_for(5)  # several 1.5s retry timeouts
+    assert len(asked) > n0
+
+
+# -- TCP transport ---------------------------------------------------------
+
+
+def test_tcp_handshake_over_real_sockets():
+    """OverlayTests OVER_TCP flavor: PeerDoor accept + TCPPeer.initiate."""
+    from stellar_tpu.overlay import PeerRecord
+
+    clock = VirtualClock()
+    cfg_a = T.get_test_config(10)
+    cfg_b = T.get_test_config(11)
+    for cfg in (cfg_a, cfg_b):
+        cfg.RUN_STANDALONE = False
+        cfg.HTTP_PORT = 0
+    a = Application.create(clock, cfg_a, new_db=True)
+    b = Application.create(clock, cfg_b, new_db=True)
+    a.start()
+    b.start()
+    assert b.overlay_manager.door is not None and b.overlay_manager.door.sock
+
+    a.overlay_manager.connect_to(PeerRecord("127.0.0.1", cfg_b.PEER_PORT))
+    ok = clock.crank_until(
+        lambda: a.overlay_manager.get_authenticated_peer_count() == 1
+        and b.overlay_manager.get_authenticated_peer_count() == 1,
+        timeout=10,
+    )
+    assert ok
+    a.graceful_stop()
+    b.graceful_stop()
+
+
+def test_handshake_rejects_damaged_auth(two_apps):
+    """Valid certs but a corrupted AUTH frame: MAC check must kill it."""
+    clock, a, b = two_apps
+    conn = LoopbackPeerConnection(a, b)
+    conn.initiator.damage_auth = True
+    crank(clock)
+    assert not conn.acceptor.is_authenticated()
+    assert not conn.initiator.is_authenticated()
