@@ -29,6 +29,7 @@ class CommandHandler:
     def __init__(self, app):
         self.app = app
         self.sock: Optional[socket.socket] = None
+        self._clients: set = set()
         self.routes: Dict[str, Callable[[dict], object]] = {
             "info": self.handle_info,
             "metrics": self.handle_metrics,
@@ -75,6 +76,16 @@ class CommandHandler:
             except OSError:
                 pass
             self.sock = None
+        for conn in list(self._clients):
+            self._close_client(conn)
+
+    def _close_client(self, conn) -> None:
+        self._clients.discard(conn)
+        self.app.clock.unwatch(conn)
+        try:
+            conn.close()
+        except OSError:
+            pass
 
     def _on_accept(self, _events) -> None:
         while True:
@@ -83,7 +94,14 @@ class CommandHandler:
             except (BlockingIOError, OSError):
                 return
             conn.setblocking(False)
+            self._clients.add(conn)
             buf = bytearray()
+            # slow-loris guard: drop request-less connections after 10s
+            from ..util import VirtualTimer
+
+            deadline = VirtualTimer(self.app.clock)
+            deadline.expires_from_now(10.0)
+            deadline.async_wait(lambda: self._close_client(conn))
 
             def on_io(events, conn=conn, buf=buf):
                 try:
@@ -91,12 +109,13 @@ class CommandHandler:
                 except (BlockingIOError, InterruptedError):
                     return
                 except OSError:
-                    self.app.clock.unwatch(conn)
-                    conn.close()
+                    deadline.cancel()
+                    self._close_client(conn)
                     return
                 if chunk:
                     buf += chunk
                 if (not chunk) or b"\r\n\r\n" in buf or len(buf) > MAX_REQUEST:
+                    deadline.cancel()
                     self.app.clock.unwatch(conn)
                     self._respond(conn, bytes(buf))
 
@@ -125,19 +144,34 @@ class CommandHandler:
             f"Content-Type: application/json\r\n"
             f"Content-Length: {len(body)}\r\n\r\n"
         ).encode()
-        try:
-            # responses can exceed the send buffer (e.g. /metrics); go
-            # blocking with a timeout for the single write-out
-            conn.setblocking(True)
-            conn.settimeout(5.0)
-            conn.sendall(hdr + body)
-        except OSError:
-            pass
-        finally:
+        # drain through the selector; never block the reactor thread
+        out = memoryview(hdr + body)
+
+        def on_writable(_events, conn=conn):
+            nonlocal out
             try:
-                conn.close()
+                n = conn.send(out)
+            except (BlockingIOError, InterruptedError):
+                return
             except OSError:
-                pass
+                self._close_client(conn)
+                return
+            out = out[n:]
+            if not len(out):
+                self._close_client(conn)
+
+        try:
+            n = conn.send(out)
+            out = out[n:]
+        except (BlockingIOError, InterruptedError):
+            pass
+        except OSError:
+            self._close_client(conn)
+            return
+        if len(out):
+            self.app.clock.watch(conn, selectors.EVENT_WRITE, on_writable)
+        else:
+            self._close_client(conn)
 
     def execute(self, target: str):
         """Dispatch a request path like '/info' or 'tx?blob=...'; also the

@@ -64,22 +64,20 @@ class Floodgate:
         return False
 
     def broadcast(self, msg: StellarMessage, force: bool) -> None:
-        """Send to every authenticated peer that hasn't seen it yet
-        (Floodgate.cpp:84-110).  A missing record means the message
-        originated locally — create one and flood.  ``force`` re-floods even
-        when the record exists (SCP rebroadcast)."""
+        """Send to every authenticated peer not already told
+        (Floodgate.cpp:84-110).  The record is created when missing (locally
+        originated message); ``force`` resets it so our own SCP messages
+        re-flood each rebroadcast tick even to peers already told."""
         if self._shutting_down:
             return
         key = self.message_key(msg)
         rec = self.flood_map.get(key)
-        if rec is None:
+        if rec is None or force:
             lm = self.app.ledger_manager
             seq = lm.get_ledger_num() if lm.last_closed is not None else 0
             rec = FloodRecord(seq, msg)
             self.flood_map[key] = rec
             self.m_added.set_count(len(self.flood_map))
-        elif not force:
-            return
         om = self.app.overlay_manager
         for peer in list(om.authenticated_peers()):
             if peer not in rec.peers_told:

@@ -66,19 +66,24 @@ class LoopbackPeer(Peer):
         """Move one queued frame into the remote peer, applying faults."""
         if self.remote is None or not self.out_queue:
             return False
-        data = self.out_queue.popleft()
+        entry = self.out_queue.popleft()
+        # entries re-queued by a fault are marked stale so the duplicate /
+        # reorder faults can't recurse and delivery always terminates
+        data, fresh = entry if isinstance(entry, tuple) else (entry, True)
 
         if self.drop_prob > 0 and self._rng.random() < self.drop_prob:
             log.debug("loopback dropping frame")
             return True
-        if self.duplicate_prob > 0 and self._rng.random() < self.duplicate_prob:
+        if fresh and self.duplicate_prob > 0 and (
+            self._rng.random() < self.duplicate_prob
+        ):
             log.debug("loopback duplicating frame")
-            self.out_queue.appendleft(data)
-        if self.reorder_prob > 0 and len(self.out_queue) > 0 and (
+            self.out_queue.append((data, False))
+        if fresh and self.reorder_prob > 0 and len(self.out_queue) > 0 and (
             self._rng.random() < self.reorder_prob
         ):
             log.debug("loopback reordering frame")
-            self.out_queue.append(data)
+            self.out_queue.append((data, False))
             return True
         if self.damage_prob > 0 and self._rng.random() < self.damage_prob:
             log.debug("loopback damaging frame")

@@ -60,6 +60,23 @@ class PeerState:
 # message types exempt from MAC/sequence (sent before keys exist)
 _UNMACED = (MessageType.HELLO2, MessageType.ERROR_MSG)
 
+# hot-path dispatch table (resolved per-instance via getattr)
+_DISPATCH = {
+    MessageType.ERROR_MSG: "recv_error",
+    MessageType.HELLO2: "recv_hello2",
+    MessageType.AUTH: "recv_auth",
+    MessageType.DONT_HAVE: "recv_dont_have",
+    MessageType.GET_PEERS: "recv_get_peers",
+    MessageType.PEERS: "recv_peers",
+    MessageType.GET_TX_SET: "recv_get_tx_set",
+    MessageType.TX_SET: "recv_tx_set",
+    MessageType.TRANSACTION: "recv_transaction",
+    MessageType.GET_SCP_QUORUMSET: "recv_get_scp_quorum_set",
+    MessageType.SCP_QUORUMSET: "recv_scp_quorum_set",
+    MessageType.SCP_MESSAGE: "recv_scp_message",
+    MessageType.GET_SCP_STATE: "recv_get_scp_state",
+}
+
 
 class Peer:
     def __init__(self, app, role: str):
@@ -230,25 +247,11 @@ class Peer:
             log.warning("recv %s before handshake from %r", t.name, self)
             self.drop()
             return
-        handler = {
-            MessageType.ERROR_MSG: self.recv_error,
-            MessageType.HELLO2: self.recv_hello2,
-            MessageType.AUTH: self.recv_auth,
-            MessageType.DONT_HAVE: self.recv_dont_have,
-            MessageType.GET_PEERS: self.recv_get_peers,
-            MessageType.PEERS: self.recv_peers,
-            MessageType.GET_TX_SET: self.recv_get_tx_set,
-            MessageType.TX_SET: self.recv_tx_set,
-            MessageType.TRANSACTION: self.recv_transaction,
-            MessageType.GET_SCP_QUORUMSET: self.recv_get_scp_quorum_set,
-            MessageType.SCP_QUORUMSET: self.recv_scp_quorum_set,
-            MessageType.SCP_MESSAGE: self.recv_scp_message,
-            MessageType.GET_SCP_STATE: self.recv_get_scp_state,
-        }.get(t)
-        if handler is None:
+        name = _DISPATCH.get(t)
+        if name is None:
             log.warning("unhandled message type %s from %r", t, self)
             return
-        handler(msg)
+        getattr(self, name)(msg)
 
     # -- handshake handlers -------------------------------------------------
     def recv_hello2(self, msg: StellarMessage) -> None:
@@ -312,15 +315,11 @@ class Peer:
         if not om.accept_authenticated_peer(self):
             self.drop(ErrorCode.ERR_LOAD, "peer rejected")
             return
-        # learn more of the network + pull the peer's SCP state
+        # learn more of the network, and push our recent SCP state so a
+        # late joiner can follow consensus (Peer.cpp:1095: seq 0 = recent)
         self.send_get_peers()
         if self.app.herder is not None:
-            self.send_message(
-                StellarMessage(
-                    MessageType.GET_SCP_STATE,
-                    max(0, self.app.ledger_manager.get_ledger_num() - 1),
-                )
-            )
+            self.app.herder.send_scp_state_to_peer(0, self)
 
     def recv_error(self, msg: StellarMessage) -> None:
         err: Error = msg.value

@@ -1,0 +1,149 @@
+"""LoadGenerator — synthetic account/payment load at a target tx rate
+(reference: src/simulation/LoadGenerator.{h,cpp}).
+
+Step-driven on a VirtualTimer (STEP_MSECS cadence): first funds synthetic
+accounts from the root, then streams payments between random accounts,
+submitting through the node's own Herder (and flooding, if an overlay is
+up) — exactly the reference's "tx?" path, so every generated tx takes the
+full validity + signature pipeline.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..crypto.keys import SecretKey
+from ..util import VirtualTimer, xlog
+
+log = xlog.logger("LoadGen")
+
+STEP_SECONDS = 0.1
+MIN_ACCOUNT_BALANCE = 1_000_000_000  # fund enough for many fees
+
+
+@dataclass
+class TestAccount:
+    """A synthetic account with local sequence tracking
+    (LoadGenerator.h TestAccount)."""
+
+    key: SecretKey
+    seq: int = 0
+    created: bool = False
+
+
+class LoadGenerator:
+    def __init__(self, seed: int = 1337):
+        self.accounts: List[TestAccount] = []
+        self._rng = random.Random(seed)
+        self.timer: Optional[VirtualTimer] = None
+        self.pending_accounts = 0
+        self.pending_txs = 0
+        self.rate = 10
+        self._root_seq = 0
+        self._running = False
+
+    # -- public api ---------------------------------------------------------
+    def generate_load(self, app, n_accounts: int, n_txs: int, rate: int) -> None:
+        """(CommandHandler 'generateload') queue work and start stepping."""
+        self.pending_accounts += n_accounts
+        self.pending_txs += n_txs
+        self.rate = max(1, rate)
+        if not self._running:
+            self._running = True
+            if self.timer is None:
+                self.timer = VirtualTimer(app.clock)
+            self._schedule(app)
+
+    def is_done(self) -> bool:
+        return self.pending_accounts == 0 and self.pending_txs == 0
+
+    # -- stepping -----------------------------------------------------------
+    def _schedule(self, app) -> None:
+        self.timer.expires_from_now(STEP_SECONDS)
+        self.timer.async_wait(lambda: self._step(app))
+
+    def _step(self, app) -> None:
+        if self.is_done():
+            self._running = False
+            log.info("load generation complete (%d accounts live)", len(self.accounts))
+            return
+        budget = max(1, int(self.rate * STEP_SECONDS))
+        submitted = 0
+        while submitted < budget and self.pending_accounts > 0:
+            if self._submit_create_account(app):
+                submitted += 1
+            self.pending_accounts -= 1
+        while submitted < budget and self.pending_txs > 0 and self._have_live_accounts():
+            if self._submit_payment(app):
+                submitted += 1
+            self.pending_txs -= 1
+        self._schedule(app)
+
+    def _have_live_accounts(self) -> bool:
+        return sum(1 for a in self.accounts if a.created) >= 2
+
+    # -- tx builders --------------------------------------------------------
+    def _root(self, app):
+        from ..tx import testutils as T
+        from ..ledger.accountframe import AccountFrame
+
+        key = T.root_key_for(app)
+        if self._root_seq == 0:
+            frame = AccountFrame.load_account(key.get_public_key(), app.database)
+            self._root_seq = frame.get_seq_num()
+        return key
+
+    def _submit(self, app, tx) -> bool:
+        from ..herder.herder import TX_STATUS_PENDING
+
+        status = app.herder.recv_transaction(tx)
+        if status != TX_STATUS_PENDING:
+            log.debug("loadgen tx rejected: %s", status)
+            return False
+        if app.overlay_manager is not None:
+            app.overlay_manager.broadcast_message(tx.to_stellar_message())
+        return True
+
+    def _submit_create_account(self, app) -> bool:
+        from ..tx import testutils as T
+
+        root = self._root(app)
+        acct = TestAccount(
+            SecretKey.pseudo_random_for_testing(5000 + len(self.accounts))
+        )
+        self._root_seq += 1
+        tx = T.tx_from_ops(
+            app,
+            root,
+            self._root_seq,
+            [T.create_account_op(acct.key, MIN_ACCOUNT_BALANCE)],
+        )
+        if not self._submit(app, tx):
+            self._root_seq -= 1
+            return False
+        acct.created = True  # optimistic; consensus applies it
+        self.accounts.append(acct)
+        return True
+
+    def _submit_payment(self, app) -> bool:
+        from ..tx import testutils as T
+        from ..ledger.accountframe import AccountFrame
+
+        live = [a for a in self.accounts if a.created]
+        src, dst = self._rng.sample(live, 2)
+        if src.seq == 0:
+            frame = AccountFrame.load_account(src.key.get_public_key(), app.database)
+            if frame is None:
+                return False  # not applied yet; retry never — skip
+            src.seq = frame.get_seq_num()
+        src.seq += 1
+        amount = self._rng.randint(10, 10_000)
+        tx = T.tx_from_ops(
+            app, src.key, src.seq, [T.payment_op(dst.key, amount)]
+        )
+        if not self._submit(app, tx):
+            src.seq -= 1
+            return False
+        return True

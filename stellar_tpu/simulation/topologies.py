@@ -1,0 +1,95 @@
+"""Topologies — canned multi-node network shapes
+(reference: src/simulation/Topologies.{h,cpp}).
+
+Each builder returns a ready-but-not-started Simulation; call
+``start_all_nodes`` then ``crank_until(have_all_externalized...)``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..crypto.keys import SecretKey
+from ..util import VirtualClock
+from ..xdr.scp import SCPQuorumSet
+from .simulation import OVER_LOOPBACK, Simulation
+
+
+def _keys(n: int) -> List[SecretKey]:
+    return [SecretKey.pseudo_random_for_testing(i + 1) for i in range(n)]
+
+
+def pair(mode: str = OVER_LOOPBACK, clock: Optional[VirtualClock] = None) -> Simulation:
+    """Two validators, each requiring both (Topologies::pair)."""
+    sim = Simulation(mode, clock)
+    k = _keys(2)
+    qset = SCPQuorumSet(2, [x.get_public_key() for x in k], [])
+    for x in k:
+        sim.add_node(x, qset)
+    sim.add_pending_connection(k[0], k[1])
+    return sim
+
+
+def cycle4(clock: Optional[VirtualClock] = None) -> Simulation:
+    """4 nodes in a ring; each trusts itself + next (threshold 2 of 2) —
+    the reference's pathological-but-live shape (Topologies::cycle4)."""
+    sim = Simulation(OVER_LOOPBACK, clock)
+    k = _keys(4)
+    for i, x in enumerate(k):
+        nxt = k[(i + 1) % 4]
+        qset = SCPQuorumSet(
+            2, [x.get_public_key(), nxt.get_public_key()], []
+        )
+        sim.add_node(x, qset)
+    for i in range(4):
+        sim.add_pending_connection(k[i], k[(i + 1) % 4])
+    # cross links like the reference (0-2, 1-3)
+    sim.add_pending_connection(k[0], k[2])
+    sim.add_pending_connection(k[1], k[3])
+    return sim
+
+
+def core(
+    n: int,
+    threshold: Optional[int] = None,
+    mode: str = OVER_LOOPBACK,
+    clock: Optional[VirtualClock] = None,
+) -> Simulation:
+    """Fully connected core of n validators sharing one quorum set
+    (Topologies::core)."""
+    sim = Simulation(mode, clock)
+    k = _keys(n)
+    if threshold is None:
+        threshold = n - (n - 1) // 3  # BFT majority
+    qset = SCPQuorumSet(threshold, [x.get_public_key() for x in k], [])
+    for x in k:
+        sim.add_node(x, qset)
+    for i in range(n):
+        for j in range(i + 1, n):
+            sim.add_pending_connection(k[i], k[j])
+    return sim
+
+
+def hierarchical_quorum_simplified(
+    core_n: int = 4,
+    outer_n: int = 2,
+    clock: Optional[VirtualClock] = None,
+) -> Simulation:
+    """A core plus outer validators whose quorum slice is the core
+    (Topologies::hierarchicalQuorumSimplified)."""
+    sim = Simulation(OVER_LOOPBACK, clock)
+    ck = _keys(core_n)
+    core_threshold = core_n - (core_n - 1) // 3
+    core_qset = SCPQuorumSet(core_threshold, [x.get_public_key() for x in ck], [])
+    for x in ck:
+        sim.add_node(x, core_qset)
+    for i in range(core_n):
+        for j in range(i + 1, core_n):
+            sim.add_pending_connection(ck[i], ck[j])
+    ok = [SecretKey.pseudo_random_for_testing(100 + i) for i in range(outer_n)]
+    for i, x in enumerate(ok):
+        # outer node: itself + the whole core as inner set
+        qset = SCPQuorumSet(2, [x.get_public_key()], [core_qset])
+        sim.add_node(x, qset)
+        sim.add_pending_connection(x, ck[i % core_n])
+    return sim

@@ -149,6 +149,13 @@ class VirtualClock:
         n += self._fire_due_timers()
         if n == 0:
             if self.mode == VIRTUAL_TIME:
+                # real sockets under virtual time: give in-flight IO a short
+                # real-time window before leaping the clock, else timers
+                # (ballot timeouts etc.) race ahead of kernel delivery
+                if self._n_watched > 0:
+                    n += self._poll_io(0.005)
+                    if n:
+                        return n
                 nd = self.next_deadline()
                 if nd is not None:
                     self._virtual_now = max(self._virtual_now, nd)
