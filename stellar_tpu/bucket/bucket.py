@@ -1,0 +1,210 @@
+"""Bucket — immutable, sorted, content-hashed XDR flat file of ledger entries
+(reference: src/bucket/Bucket.{h,cpp}, src/bucket/LedgerCmp.h).
+
+A bucket holds BucketEntry records (LIVEENTRY LedgerEntry | DEADENTRY
+LedgerKey) sorted by entry identity; its hash is the SHA256 of the record
+stream as written.  The two construction paths are ``fresh`` (one ledger's
+live+dead batch, Bucket.cpp:322) and ``merge`` (single-pass 2-way merge with
+shadow elision, Bucket.cpp:367-430).  ``apply`` replays a bucket into the SQL
+store for catchup-minimal (Bucket.cpp "Bucket::apply").
+
+Entry identity order is defined by (entry type, key XDR bytes) — canonical
+within this framework; hashes are framework-local, like the reference's are
+network-local.
+"""
+
+from __future__ import annotations
+
+import os
+import uuid
+from typing import Iterable, Iterator, List, Optional, Tuple
+
+from ..crypto import SHA256
+from ..ledger.entryframe import ledger_key_of, store_add_or_change, store_delete_key
+from ..util.xdrstream import XDRInputFileStream, XDROutputFileStream
+from ..xdr.entries import LedgerEntry
+from ..xdr.ledger import BucketEntry, BucketEntryType, LedgerKey
+
+ZERO_HASH = b"\x00" * 32
+
+
+def entry_identity(e: BucketEntry) -> Tuple[int, bytes]:
+    """Sort/identity key of a BucketEntry: live and dead entries with the
+    same LedgerKey compare equal (LedgerCmp.h BucketEntryIdCmp)."""
+    if e.type == BucketEntryType.LIVEENTRY:
+        k = ledger_key_of(e.value)
+    else:
+        k = e.value
+    return (int(k.type), k.value.to_xdr())
+
+
+class _Peekable:
+    """Iterator with 1-entry lookahead over (identity, BucketEntry) pairs."""
+
+    __slots__ = ("_it", "head")
+
+    def __init__(self, it: Iterator[BucketEntry]):
+        self._it = it
+        self.head: Optional[Tuple[Tuple[int, bytes], BucketEntry]] = None
+        self.advance()
+
+    def advance(self) -> None:
+        try:
+            e = next(self._it)
+            self.head = (entry_identity(e), e)
+        except StopIteration:
+            self.head = None
+
+
+def _shadowed(identity, shadow_iters: List[_Peekable]) -> bool:
+    """True if an entry with this identity appears in any shadow stream
+    (Bucket.cpp maybe_put): each shadow iterator advances monotonically —
+    the candidate stream is itself sorted, so one pass suffices."""
+    for si in shadow_iters:
+        while si.head is not None and si.head[0] < identity:
+            si.advance()
+        if si.head is not None and si.head[0] == identity:
+            return True
+    return False
+
+
+class Bucket:
+    """Immutable handle on one bucket file (possibly the empty bucket)."""
+
+    __slots__ = ("path", "hash", "objects")
+
+    def __init__(self, path: str = "", hash: bytes = ZERO_HASH, objects: int = 0):
+        self.path = path
+        self.hash = hash
+        self.objects = objects
+
+    def is_empty(self) -> bool:
+        return self.hash == ZERO_HASH
+
+    def get_hash(self) -> bytes:
+        return self.hash
+
+    def __iter__(self) -> Iterator[BucketEntry]:
+        if not self.path or not os.path.exists(self.path):
+            if self.hash != ZERO_HASH:
+                # a non-empty bucket with no backing file is always
+                # corruption — iterating it as empty would silently
+                # diverge the bucket-list hash
+                raise RuntimeError(
+                    f"bucket file missing for {self.hash.hex()}: {self.path!r}"
+                )
+            return
+        with XDRInputFileStream(self.path) as f:
+            while True:
+                e = f.read_one(BucketEntry)
+                if e is None:
+                    return
+                yield e
+
+    def contains_identity(self, e: BucketEntry) -> bool:
+        """Linear scan (reference containsBucketIdentity — test helper)."""
+        ident = entry_identity(e)
+        return any(entry_identity(x) == ident for x in self)
+
+    def apply(self, db) -> None:
+        """Replay entries into the SQL store (catchup-minimal path).  Buckets
+        are header-independent, so a throwaway header/delta is used."""
+        from ..ledger.delta import LedgerDelta
+        from ..xdr.ledger import LedgerHeader
+
+        if self.is_empty():
+            return
+        with db.transaction():
+            for e in self:
+                delta = LedgerDelta(LedgerHeader(), db, update_last_modified=False)
+                if e.type == BucketEntryType.LIVEENTRY:
+                    store_add_or_change(e.value, delta, db)
+                else:
+                    store_delete_key(e.value, delta, db)
+                delta.commit()
+
+    # -- construction ------------------------------------------------------
+    @staticmethod
+    def fresh(
+        bucket_manager,
+        live_entries: Iterable[LedgerEntry],
+        dead_entries: Iterable[LedgerKey],
+    ) -> "Bucket":
+        """One ledger's output batch as a bucket: dead keys win over live
+        entries of the same identity (Bucket.cpp:322-363 merges the dead
+        bucket as 'new')."""
+        live = [BucketEntry(BucketEntryType.LIVEENTRY, e) for e in live_entries]
+        dead = [BucketEntry(BucketEntryType.DEADENTRY, k) for k in dead_entries]
+        live.sort(key=entry_identity)
+        dead.sort(key=entry_identity)
+        return _write_merged(
+            bucket_manager, iter(live), iter(dead), [], keep_dead_entries=True
+        )
+
+    @staticmethod
+    def merge(
+        bucket_manager,
+        old_bucket: "Bucket",
+        new_bucket: "Bucket",
+        shadows: Iterable["Bucket"] = (),
+        keep_dead_entries: bool = True,
+    ) -> "Bucket":
+        """Single-pass merge: new wins over old on identity collision; any
+        entry present in a shadow (younger level) is elided; DEADENTRYs are
+        dropped entirely when ``keep_dead_entries`` is false (bottom level)."""
+        shadow_iters = [_Peekable(iter(s)) for s in shadows]
+        return _write_merged(
+            bucket_manager,
+            iter(old_bucket),
+            iter(new_bucket),
+            shadow_iters,
+            keep_dead_entries,
+        )
+
+
+def _write_merged(
+    bucket_manager,
+    old_it: Iterator[BucketEntry],
+    new_it: Iterator[BucketEntry],
+    shadow_iters: List[_Peekable],
+    keep_dead_entries: bool,
+) -> Bucket:
+    tmp = os.path.join(
+        bucket_manager.get_tmp_dir(), f"tmp-bucket-{uuid.uuid4().hex}.xdr"
+    )
+    hasher = SHA256()
+    objects = 0
+    oi = _Peekable(old_it)
+    ni = _Peekable(new_it)
+    with XDROutputFileStream(tmp, hasher=hasher) as out:
+
+        def put(e: BucketEntry, identity) -> None:
+            nonlocal objects
+            if e.type == BucketEntryType.DEADENTRY and not keep_dead_entries:
+                return
+            if _shadowed(identity, shadow_iters):
+                return
+            out.write_one(e)
+            objects += 1
+
+        while oi.head is not None or ni.head is not None:
+            if ni.head is None:
+                put(oi.head[1], oi.head[0])
+                oi.advance()
+            elif oi.head is None:
+                put(ni.head[1], ni.head[0])
+                ni.advance()
+            elif oi.head[0] < ni.head[0]:
+                put(oi.head[1], oi.head[0])
+                oi.advance()
+            elif ni.head[0] < oi.head[0]:
+                put(ni.head[1], ni.head[0])
+                ni.advance()
+            else:  # same identity: new wins
+                put(ni.head[1], ni.head[0])
+                oi.advance()
+                ni.advance()
+    if objects == 0:
+        os.unlink(tmp)
+        return Bucket()
+    return bucket_manager.adopt_file_as_bucket(tmp, hasher.finish(), objects)

@@ -1,46 +1,156 @@
-"""BucketManager (reference: src/bucket/BucketManagerImpl.cpp).
+"""BucketManager — owns the bucket directory and the hash→Bucket map
+(reference: src/bucket/BucketManagerImpl.{h,cpp}).
 
-INTERIM (single-level) implementation: hashes each ledger's live/dead entry
-batch into a running chain so headers commit to state changes deterministically.
-The full 11-level log-structured BucketList with worker-thread merges and
-resumable FutureBuckets replaces the internals in bucket/bucketlist.py —
-this class keeps the same interface either way.
+Content-addressed: a merged/fresh bucket file is renamed to
+``bucket-<hash>.xdr`` inside the bucket dir and shared by hash thereafter.
+Worker threads adopt buckets concurrently (merges run on the pool), so the
+map is lock-guarded — the reference's one mutex-guarded subsystem outside
+crypto (BucketManagerImpl.h mBucketMutex).
+
+GC (``forget_unreferenced_buckets``) drops map entries and files whose hash
+is no longer referenced by the live bucket list, any in-progress future
+merge, or any queued-but-unpublished history checkpoint state.
 """
 
 from __future__ import annotations
 
-import json
-from typing import List
+import os
+import threading
+from typing import Dict, List, Optional
 
-from ..crypto import SHA256, sha256
-from ..xdr.ledger import BucketEntry, BucketEntryType
+from ..util import xlog
+from .bucket import ZERO_HASH, Bucket
+from .bucketlist import BucketList
+
+log = xlog.logger("Bucket")
 
 
 class BucketManager:
     def __init__(self, app):
         self.app = app
-        self._hash = b"\x00" * 32
+        self.bucket_list = BucketList()
+        self._buckets: Dict[bytes, Bucket] = {}
+        self._lock = threading.Lock()
+        # NB: must NOT live under TMP_DIR_PATH — that root is wiped on app
+        # construction, and buckets must survive restart (merge resume).
+        self.bucket_dir = os.path.abspath(app.config.BUCKET_DIR_PATH)
+        os.makedirs(self.bucket_dir, exist_ok=True)
+        # sweep merge temp files orphaned by a crash (the dir is persistent
+        # by design, so nothing else cleans them)
+        for name in os.listdir(self.bucket_dir):
+            if name.startswith("tmp-bucket-"):
+                try:
+                    os.unlink(os.path.join(self.bucket_dir, name))
+                except OSError:
+                    pass
 
+    # -- paths -------------------------------------------------------------
+    def get_tmp_dir(self) -> str:
+        return self.bucket_dir
+
+    def bucket_filename(self, h: bytes) -> str:
+        return os.path.join(self.bucket_dir, f"bucket-{h.hex()}.xdr")
+
+    # -- adoption / lookup (BucketManagerImpl::adoptFileAsBucket) ----------
+    def adopt_file_as_bucket(self, path: str, h: bytes, objects: int) -> Bucket:
+        with self._lock:
+            existing = self._buckets.get(h)
+            if existing is not None:
+                os.unlink(path)
+                return existing
+            canonical = self.bucket_filename(h)
+            os.replace(path, canonical)
+            b = Bucket(canonical, h, objects)
+            self._buckets[h] = b
+            return b
+
+    def get_bucket_by_hash(self, h: bytes) -> Bucket:
+        if h == ZERO_HASH:
+            return Bucket()
+        with self._lock:
+            b = self._buckets.get(h)
+            if b is not None:
+                return b
+            path = self.bucket_filename(h)
+            if os.path.exists(path):
+                b = Bucket(path, h)
+                self._buckets[h] = b
+                return b
+        raise KeyError(f"no bucket with hash {h.hex()}")
+
+    def has_bucket(self, h: bytes) -> bool:
+        if h == ZERO_HASH:
+            return True
+        with self._lock:
+            return h in self._buckets or os.path.exists(self.bucket_filename(h))
+
+    # -- ledger-close interface (LedgerManager calls these) ----------------
     def add_batch(self, ledger_seq: int, live_entries, dead_entries) -> None:
-        h = SHA256()
-        h.add(self._hash)
-        for e in live_entries:
-            h.add(BucketEntry(BucketEntryType.LIVEENTRY, e).to_xdr())
-        for k in dead_entries:
-            h.add(BucketEntry(BucketEntryType.DEADENTRY, k).to_xdr())
-        self._hash = h.finish()
+        self.bucket_list.add_batch(self.app, ledger_seq, live_entries, dead_entries)
 
     def get_hash(self) -> bytes:
-        return self._hash
+        return self.bucket_list.get_hash()
 
     def archive_state_json(self, ledger_seq: int) -> str:
-        return json.dumps(
-            {"version": 1, "currentLedger": ledger_seq, "bucketHash": self._hash.hex()}
-        )
+        from ..history.archive import HistoryArchiveState
+
+        return HistoryArchiveState.from_bucket_list(
+            ledger_seq, self.bucket_list
+        ).to_json()
+
+    # -- restart / catchup (BucketManagerImpl::assumeState) ----------------
+    def assume_state(self, state_json: str) -> None:
+        """Adopt a serialized bucket-list shape (boot after restart, or the
+        end of catchup-minimal).  Buckets must exist in the bucket dir."""
+        from ..bucket.futurebucket import FutureBucket
+        from ..history.archive import HistoryArchiveState
+
+        has = HistoryArchiveState.from_json(state_json)
+        for i, lev_state in enumerate(has.current_buckets):
+            lev = self.bucket_list.get_level(i)
+            lev.curr = self.get_bucket_by_hash(lev_state.curr)
+            lev.snap = self.get_bucket_by_hash(lev_state.snap)
+            lev.next = FutureBucket.from_state(lev_state.next)
+        self.bucket_list.restart_merges(self.app)
+
+    def restart_merges(self) -> None:
+        self.bucket_list.restart_merges(self.app)
+
+    # -- GC (BucketManagerImpl::forgetUnreferencedBuckets) -----------------
+    def referenced_hashes(self) -> set:
+        refs = set()
+        for lev in self.bucket_list.levels:
+            refs.add(lev.curr.get_hash())
+            refs.add(lev.snap.get_hash())
+            refs.update(lev.next.referenced_hashes())
+        # queued-but-unpublished checkpoints still need their buckets
+        from ..history import publish as publish_queue
+        from ..history.archive import HistoryArchiveState
+
+        for _seq, state_json in publish_queue.queued_checkpoints(self.app.database):
+            refs.update(HistoryArchiveState.from_json(state_json).all_bucket_hashes())
+        refs.discard(ZERO_HASH)
+        return refs
 
     def forget_unreferenced_buckets(self) -> None:
-        pass
-
-    def assume_state(self, state_json: str) -> None:
-        st = json.loads(state_json)
-        self._hash = bytes.fromhex(st.get("bucketHash", "00" * 32))
+        # A worker adopts its merge output before the future records the
+        # output hash; GC while a merge is in flight could catch that window
+        # and delete the fresh output.  Merges only start from the main
+        # thread, so checking completion first closes the race.
+        for lev in self.bucket_list.levels:
+            if lev.next.is_live() and not lev.next._done.is_set():
+                return  # defer GC to the next close
+        try:
+            refs = self.referenced_hashes()
+        except Exception as e:
+            log.error("skipping bucket GC, could not compute referenced set: %s", e)
+            return
+        with self._lock:
+            for h in list(self._buckets):
+                if h not in refs:
+                    b = self._buckets.pop(h)
+                    try:
+                        if b.path:
+                            os.unlink(b.path)
+                    except OSError:
+                        pass

@@ -282,3 +282,11 @@ class AccountFrame(EntryFrame):
         db.execute("DELETE FROM signers WHERE accountid=?", (aid,))
         delta.delete_entry_frame(self)
         self.store_in_cache(db, self.get_key(), None)
+
+    @classmethod
+    def store_delete_by_key(cls, delta, db, key: LedgerKey) -> None:
+        aid = _aid(key.value.accountID)
+        db.execute("DELETE FROM accounts WHERE accountid=?", (aid,))
+        db.execute("DELETE FROM signers WHERE accountid=?", (aid,))
+        delta.delete_entry(key)
+        cls.store_in_cache(db, key, None)

@@ -113,3 +113,61 @@ class EntryFrame:
     @staticmethod
     def check_exists(db, sql: str, params) -> bool:
         return db.query_one(sql, params) is not None
+
+
+def ledger_key_of(entry: LedgerEntry) -> LedgerKey:
+    """LedgerKey identifying a LedgerEntry (reference: LedgerEntryKey,
+    src/ledger/EntryFrame.cpp)."""
+    from ..xdr.ledger import LedgerKeyAccount, LedgerKeyOffer, LedgerKeyTrustLine
+
+    ty = entry.data.type
+    d = entry.data.value
+    if ty == LedgerEntryType.ACCOUNT:
+        return LedgerKey(ty, LedgerKeyAccount(d.accountID))
+    if ty == LedgerEntryType.TRUSTLINE:
+        return LedgerKey(ty, LedgerKeyTrustLine(d.accountID, d.asset))
+    if ty == LedgerEntryType.OFFER:
+        return LedgerKey(ty, LedgerKeyOffer(d.sellerID, d.offerID))
+    raise ValueError(f"unknown ledger entry type {ty}")
+
+
+def frame_from_entry(entry: LedgerEntry) -> "EntryFrame":
+    """Factory: wrap a LedgerEntry in its typed frame
+    (reference: EntryFrame::FromXDR, src/ledger/EntryFrame.cpp:33)."""
+    from .accountframe import AccountFrame
+    from .offerframe import OfferFrame
+    from .trustframe import TrustFrame
+
+    ty = entry.data.type
+    if ty == LedgerEntryType.ACCOUNT:
+        return AccountFrame(entry)
+    if ty == LedgerEntryType.TRUSTLINE:
+        return TrustFrame(entry)
+    if ty == LedgerEntryType.OFFER:
+        return OfferFrame(entry)
+    raise ValueError(f"unknown ledger entry type {ty}")
+
+
+def store_add_or_change(entry: LedgerEntry, delta, db) -> None:
+    """Upsert a raw LedgerEntry (reference: EntryFrame::storeAddOrChange,
+    used by Bucket::apply during catchup-minimal)."""
+    frame = frame_from_entry(entry)
+    if type(frame).exists(db, frame.get_key()):
+        frame.store_change(delta, db)
+    else:
+        frame.store_add(delta, db)
+
+
+def store_delete_key(key: LedgerKey, delta, db) -> None:
+    """Delete by LedgerKey regardless of whether the row exists
+    (reference: EntryFrame::storeDelete(delta, db, key))."""
+    from .accountframe import AccountFrame
+    from .offerframe import OfferFrame
+    from .trustframe import TrustFrame
+
+    cls = {
+        LedgerEntryType.ACCOUNT: AccountFrame,
+        LedgerEntryType.TRUSTLINE: TrustFrame,
+        LedgerEntryType.OFFER: OfferFrame,
+    }[key.type]
+    cls.store_delete_by_key(delta, db, key)

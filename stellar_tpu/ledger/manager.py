@@ -116,14 +116,26 @@ class LedgerManager:
         self.state = LedgerState.LM_SYNCED_STATE
 
     def load_last_known_ledger(self) -> None:
-        from ..main.persistentstate import K_LAST_CLOSED_LEDGER, PersistentState
+        from ..main.persistentstate import (
+            K_HISTORY_ARCHIVE_STATE,
+            K_LAST_CLOSED_LEDGER,
+            PersistentState,
+        )
 
-        last = PersistentState(self.database).get_state(K_LAST_CLOSED_LEDGER)
+        ps = PersistentState(self.database)
+        last = ps.get_state(K_LAST_CLOSED_LEDGER)
         if not last:
             raise RuntimeError("No ledger in the DB")
         frame = LedgerHeaderFrame.load_by_hash(self.database, bytes.fromhex(last))
         if frame is None:
             raise RuntimeError("Could not load ledger from database")
+        # restore the bucket list (incl. re-launching any in-progress
+        # merges) before anything recomputes the bucket hash
+        has = ps.get_state(K_HISTORY_ARCHIVE_STATE)
+        if has:
+            self.app.bucket_manager.assume_state(has)
+            if self.app.bucket_manager.get_hash() != frame.header.bucketListHash:
+                raise RuntimeError("bucket list hash does not match resumed header")
         self.current = frame
         self._advance_ledger_pointers()
         self.state = LedgerState.LM_SYNCED_STATE

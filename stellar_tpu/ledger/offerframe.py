@@ -252,3 +252,9 @@ class OfferFrame(EntryFrame):
             db.execute("DELETE FROM offers WHERE offerid=?", (self.offer.offerID,))
         delta.delete_entry_frame(self)
         self.store_in_cache(db, self.get_key(), None)
+
+    @classmethod
+    def store_delete_by_key(cls, delta, db, key) -> None:
+        db.execute("DELETE FROM offers WHERE offerid=?", (key.value.offerID,))
+        delta.delete_entry(key)
+        cls.store_in_cache(db, key, None)

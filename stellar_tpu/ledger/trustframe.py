@@ -256,3 +256,13 @@ class TrustFrame(EntryFrame):
             )
         delta.delete_entry_frame(self)
         self.store_in_cache(db, self.get_key(), None)
+
+    @classmethod
+    def store_delete_by_key(cls, delta, db, key) -> None:
+        _, issuer, code = asset_to_cols(key.value.asset)
+        db.execute(
+            "DELETE FROM trustlines WHERE accountid=? AND issuer=? AND assetcode=?",
+            (_aid(key.value.accountID), issuer, code),
+        )
+        delta.delete_entry(key)
+        cls.store_in_cache(db, key, None)

@@ -144,8 +144,13 @@ class CommandHandler:
             f"Content-Type: application/json\r\n"
             f"Content-Length: {len(body)}\r\n\r\n"
         ).encode()
-        # drain through the selector; never block the reactor thread
+        # drain through the selector; never block the reactor thread.  A
+        # client that stops reading would otherwise pin the fd + buffer
+        # forever, so the write phase gets its own deadline.
         out = memoryview(hdr + body)
+        from ..util import VirtualTimer
+
+        write_deadline = VirtualTimer(self.app.clock)
 
         def on_writable(_events, conn=conn):
             nonlocal out
@@ -154,10 +159,12 @@ class CommandHandler:
             except (BlockingIOError, InterruptedError):
                 return
             except OSError:
+                write_deadline.cancel()
                 self._close_client(conn)
                 return
             out = out[n:]
             if not len(out):
+                write_deadline.cancel()
                 self._close_client(conn)
 
         try:
@@ -169,6 +176,8 @@ class CommandHandler:
             self._close_client(conn)
             return
         if len(out):
+            write_deadline.expires_from_now(30.0)
+            write_deadline.async_wait(lambda: self._close_client(conn))
             self.app.clock.watch(conn, selectors.EVENT_WRITE, on_writable)
         else:
             self._close_client(conn)

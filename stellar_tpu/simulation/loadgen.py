@@ -71,13 +71,17 @@ class LoadGenerator:
             return
         budget = max(1, int(self.rate * STEP_SECONDS))
         submitted = 0
+        # only count work off the pending totals when the herder accepted
+        # it; a rejection (queue full, fee check) is retried next step
         while submitted < budget and self.pending_accounts > 0:
-            if self._submit_create_account(app):
-                submitted += 1
+            if not self._submit_create_account(app):
+                break
+            submitted += 1
             self.pending_accounts -= 1
         while submitted < budget and self.pending_txs > 0 and self._have_live_accounts():
-            if self._submit_payment(app):
-                submitted += 1
+            if not self._submit_payment(app):
+                break
+            submitted += 1
             self.pending_txs -= 1
         self._schedule(app)
 

@@ -32,6 +32,7 @@ def get_test_config(instance: int = 0, backend: str = "cpu") -> Config:
     cfg.HTTP_PORT = 39100 + instance * 2
     cfg.PEER_PORT = 39200 + instance * 2
     cfg.TMP_DIR_PATH = f"/tmp/stellar-tpu-test-{instance}"
+    cfg.BUCKET_DIR_PATH = f"/tmp/stellar-tpu-test-buckets-{instance}"
     cfg.SIGNATURE_BACKEND = backend
     cfg.NODE_SEED = SecretKey.from_seed(
         bytes([instance % 256]) + b"test-node-seed".ljust(31, b"\x00")

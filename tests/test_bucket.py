@@ -1,0 +1,248 @@
+"""Bucket subsystem tests (modeled on reference src/bucket/BucketTests.cpp):
+merge semantics, 11-level bucket-list invariants over many ledgers,
+persistence + merge-resume across restart, and bucket apply-to-DB."""
+
+import os
+import shutil
+
+import pytest
+
+from stellar_tpu.bucket.bucket import Bucket, ZERO_HASH, entry_identity
+from stellar_tpu.bucket.bucketlist import (
+    BucketList,
+    NUM_LEVELS,
+    level_half,
+    level_should_spill,
+    level_size,
+)
+from stellar_tpu.bucket.futurebucket import FB_HASH_INPUTS, FB_HASH_OUTPUT
+from stellar_tpu.ledger.entryframe import ledger_key_of
+from stellar_tpu.main.application import Application
+from stellar_tpu.tx import testutils as T
+from stellar_tpu.util.clock import VirtualClock
+from stellar_tpu.xdr.entries import (
+    AccountEntry,
+    LedgerEntry,
+    LedgerEntryData,
+    LedgerEntryType,
+    PublicKey,
+)
+from stellar_tpu.xdr.ledger import BucketEntry, BucketEntryType
+
+
+def account_entry(n: int, balance: int = 100) -> LedgerEntry:
+    pk = PublicKey.from_ed25519(n.to_bytes(4, "big") + b"\xab" * 28)
+    ae = AccountEntry(
+        accountID=pk,
+        balance=balance,
+        seqNum=1,
+        numSubEntries=0,
+        inflationDest=None,
+        flags=0,
+        homeDomain="",
+        thresholds=b"\x01\x00\x00\x00",
+        signers=[],
+        ext=0,
+    )
+    return LedgerEntry(0, LedgerEntryData(LedgerEntryType.ACCOUNT, ae), 0)
+
+
+@pytest.fixture
+def app():
+    clock = VirtualClock()
+    a = Application(clock, T.get_test_config(7), new_db=True)
+    yield a
+    a.database.close()
+    clock.shutdown()
+
+
+def test_fresh_bucket_sorted_and_hashed(app):
+    bm = app.bucket_manager
+    live = [account_entry(i) for i in (5, 1, 9, 3)]
+    b = Bucket.fresh(bm, live, [])
+    ids = [entry_identity(e) for e in b]
+    assert ids == sorted(ids)
+    assert b.get_hash() != ZERO_HASH
+    # determinism: same content, same hash, same (deduped) file
+    b2 = Bucket.fresh(bm, list(reversed(live)), [])
+    assert b2.get_hash() == b.get_hash()
+    assert b2.path == b.path
+
+
+def test_merge_new_wins_and_dead_tombstones(app):
+    bm = app.bucket_manager
+    old = Bucket.fresh(bm, [account_entry(1, 10), account_entry(2, 10)], [])
+    newer = Bucket.fresh(
+        bm,
+        [account_entry(2, 99)],
+        [ledger_key_of(account_entry(1))],
+    )
+    merged = Bucket.merge(bm, old, newer)
+    entries = list(merged)
+    # dead tombstone for 1 retained, live entry for 2 with new balance
+    assert len(entries) == 2
+    dead = [e for e in entries if e.type == BucketEntryType.DEADENTRY]
+    live = [e for e in entries if e.type == BucketEntryType.LIVEENTRY]
+    assert len(dead) == 1 and len(live) == 1
+    assert live[0].value.data.value.balance == 99
+    # bottom-level merge drops tombstones
+    bottom = Bucket.merge(bm, old, newer, keep_dead_entries=False)
+    assert all(e.type == BucketEntryType.LIVEENTRY for e in bottom)
+
+
+def test_merge_shadow_elision(app):
+    bm = app.bucket_manager
+    old = Bucket.fresh(bm, [account_entry(1, 10)], [])
+    new = Bucket.fresh(bm, [account_entry(2, 20)], [])
+    shadow = Bucket.fresh(bm, [account_entry(1, 77)], [])  # younger copy of 1
+    merged = Bucket.merge(bm, old, new, shadows=[shadow])
+    keys = [entry_identity(e) for e in merged]
+    assert keys == [entry_identity(BucketEntry(BucketEntryType.LIVEENTRY, account_entry(2)))]
+
+
+def test_level_spill_cadence():
+    assert level_size(0) == 4 and level_half(0) == 2
+    assert level_size(1) == 16
+    # level 0 spills every 2 ledgers; never the max level
+    assert level_should_spill(2, 0) and not level_should_spill(3, 0)
+    assert not level_should_spill(1 << 30, NUM_LEVELS - 1)
+
+
+def replay_levels(bl: BucketList):
+    """Oldest→newest replay of every bucket: final key→entry live map."""
+    state = {}
+    for lev in reversed(bl.levels):
+        for b in (lev.snap, lev.curr):
+            for e in b:
+                if e.type == BucketEntryType.LIVEENTRY:
+                    state[entry_identity(e)] = e.value
+                else:
+                    state.pop(entry_identity(e), None)
+    return state
+
+
+def test_bucket_list_invariants_200_ledgers(app):
+    bl = BucketList()  # fresh: the app's own list already holds genesis
+    expected = {}
+    hashes = []
+    for seq in range(1, 201):
+        live = [account_entry(seq % 37, balance=seq), account_entry(1000 + seq)]
+        dead = []
+        if seq % 5 == 0 and seq > 5:
+            dead = [ledger_key_of(account_entry(1000 + seq - 5))]
+        bl.add_batch(app, seq, live, dead)
+        for e in live:
+            expected[
+                entry_identity(BucketEntry(BucketEntryType.LIVEENTRY, e))
+            ] = e
+        for k in dead:
+            expected.pop(
+                entry_identity(BucketEntry(BucketEntryType.DEADENTRY, k)), None
+            )
+        hashes.append(bl.get_hash())
+    # nothing lost, nothing resurrected, latest versions visible
+    final = replay_levels(bl)
+    assert set(final) == set(expected)
+    for k, e in expected.items():
+        assert final[k].data.value.balance == e.data.value.balance
+    # hash changed every ledger
+    assert len(set(hashes)) == len(hashes)
+
+
+def test_bucket_list_deterministic(app):
+    cfg2 = T.get_test_config(8)
+    clock2 = VirtualClock()
+    app2 = Application(clock2, cfg2, new_db=True)
+    try:
+        bl1, bl2 = BucketList(), BucketList()
+        for seq in range(1, 65):
+            live = [account_entry(seq % 11, balance=seq)]
+            bl1.add_batch(app, seq, live, [])
+            bl2.add_batch(app2, seq, live, [])
+            assert bl1.get_hash() == bl2.get_hash()
+    finally:
+        app2.database.close()
+        clock2.shutdown()
+
+
+def test_future_bucket_state_roundtrip(app):
+    bm = app.bucket_manager
+    bl = bm.bucket_list
+    for seq in range(1, 33):
+        bl.add_batch(app, seq, [account_entry(seq)], [])
+    # serialize the whole list incl. any in-flight merge state
+    state = bm.archive_state_json(32)
+    from stellar_tpu.history.archive import HistoryArchiveState
+
+    has = HistoryArchiveState.from_json(state)
+    assert has.current_ledger == 32
+    assert len(has.current_buckets) == NUM_LEVELS
+    # at least one level beyond 0 has content by ledger 32
+    assert any(
+        lev.curr != ZERO_HASH for lev in has.current_buckets[1:]
+    )
+
+
+def test_persistence_and_restart_resume():
+    """Close ledgers through the full app, restart on the same DB + bucket
+    dir, and verify the bucket list resumes bit-identically
+    (BucketTests.cpp:727 'bucket persistence over app restart')."""
+    dbdir = "/tmp/stellar-tpu-test-bucket-restart"
+    shutil.rmtree(dbdir, ignore_errors=True)
+    os.makedirs(dbdir)
+    cfg = T.get_test_config(9)
+    cfg.DATABASE = f"sqlite3://{dbdir}/node.db"
+    shutil.rmtree(cfg.BUCKET_DIR_PATH, ignore_errors=True)
+
+    clock = VirtualClock()
+    app = Application.create(clock, cfg, new_db=True)
+    app.start()
+
+    def close_one(a, c):
+        target = a.ledger_manager.get_last_closed_ledger_num() + 1
+        a.herder.trigger_next_ledger(a.ledger_manager.get_ledger_num())
+        assert c.crank_until(
+            lambda: a.ledger_manager.get_last_closed_ledger_num() >= target, 30
+        )
+
+    for _ in range(10):
+        close_one(app, clock)
+    lcl = app.ledger_manager.last_closed
+    bucket_hash = app.bucket_manager.get_hash()
+    app.graceful_stop()
+    clock.shutdown()
+
+    cfg2 = T.get_test_config(9)
+    cfg2.DATABASE = f"sqlite3://{dbdir}/node.db"
+    clock2 = VirtualClock()
+    app2 = Application.create(clock2, cfg2)
+    app2.start()
+    try:
+        assert app2.ledger_manager.last_closed.hash == lcl.hash
+        assert app2.bucket_manager.get_hash() == bucket_hash
+        # and the node keeps closing ledgers on the resumed bucket list
+        for _ in range(4):
+            close_one(app2, clock2)
+        assert (
+            app2.ledger_manager.last_closed.header.ledgerSeq
+            == lcl.header.ledgerSeq + 4
+        )
+    finally:
+        app2.graceful_stop()
+        clock2.shutdown()
+
+
+def test_bucket_apply_to_db(app):
+    from stellar_tpu.ledger.accountframe import AccountFrame
+
+    bm = app.bucket_manager
+    live = [account_entry(i, balance=1000 + i) for i in range(5)]
+    b = Bucket.fresh(bm, live, [])
+    b.apply(app.database)
+    for e in live:
+        af = AccountFrame.load_account(e.data.value.accountID, app.database)
+        assert af is not None and af.account.balance == e.data.value.balance
+    # dead keys delete
+    b2 = Bucket.fresh(bm, [], [ledger_key_of(live[0])])
+    b2.apply(app.database)
+    assert AccountFrame.load_account(live[0].data.value.accountID, app.database) is None
