@@ -145,4 +145,5 @@ class Database:
         PersistentState(self).set_state("databaseschema", str(v))
 
     def close(self) -> None:
+        self.closed = True
         self._conn.close()

@@ -1,56 +1,116 @@
-"""HistoryManager (reference: src/history/HistoryManagerImpl.cpp).
+"""HistoryManager (reference: src/history/HistoryManagerImpl.{h,cpp}).
 
-INTERIM shell: checkpoint cadence constants + crash-safe queue wiring; the
-publish/catchup state machines land in publishsm.py / catchupsm.py.
+Owns checkpoint cadence, the crash-safe publish queue, and the catchup
+entry point.  Checkpoints are queued INSIDE the ledger-close SQL
+transaction (LedgerManagerImpl.cpp:710-736) and published asynchronously
+afterwards; a crash between the two just republishes on next boot.
 """
 
 from __future__ import annotations
 
+from typing import Callable, Optional
+
 from ..util import xlog
 from . import publish as publish_queue
+from .catchupsm import CATCHUP_COMPLETE, CATCHUP_MINIMAL, CatchupStateMachine
+from .publishsm import PublishRun
 
 log = xlog.logger("History")
 
-CHECKPOINT_FREQUENCY = 64  # ledgers (~5 min; HistoryManagerImpl.cpp:230)
 
-
-def checkpoint_containing_ledger(ledger: int) -> int:
-    """First checkpoint ledger >= ledger (boundaries at 63, 127, ...)."""
-    return ((ledger // CHECKPOINT_FREQUENCY) + 1) * CHECKPOINT_FREQUENCY - 1
+def checkpoint_containing_ledger(ledger: int, freq: int = 64) -> int:
+    """First checkpoint ledger >= ledger (boundaries at freq-1, 2*freq-1...)."""
+    return ((ledger // freq) + 1) * freq - 1
 
 
 class HistoryManager:
     def __init__(self, app):
         self.app = app
         self.publishing = False
+        self.catchup: Optional[CatchupStateMachine] = None
+        self._publish_success = 0
+        self._publish_failure = 0
+
+    @property
+    def checkpoint_frequency(self) -> int:
+        return self.app.config.CHECKPOINT_FREQUENCY
 
     @property
     def has_archives(self) -> bool:
         return bool(self.app.config.HISTORY)
 
-    def next_checkpoint_ledger(self, ledger: int) -> int:
-        return checkpoint_containing_ledger(ledger)
+    @property
+    def has_writable_archives(self) -> bool:
+        return any(spec.get("put") for spec in self.app.config.HISTORY.values())
 
+    def next_checkpoint_ledger(self, ledger: int) -> int:
+        return checkpoint_containing_ledger(ledger, self.checkpoint_frequency)
+
+    # -- publishing --------------------------------------------------------
     def maybe_queue_history_checkpoint(self) -> None:
-        # called after ledger pointers advanced: the just-closed ledger is LCL.
-        # Checkpoints close at seqs 63, 127, ... (HistoryManagerImpl queues
-        # when the NEXT ledger number is a multiple of the frequency).
+        # called after ledger pointers advanced: the just-closed ledger is
+        # LCL.  Checkpoints close at seqs freq-1, 2*freq-1, ... (the
+        # reference queues when the NEXT ledger is a frequency multiple).
         closed_seq = self.app.ledger_manager.last_closed.header.ledgerSeq
-        if (closed_seq + 1) % CHECKPOINT_FREQUENCY != 0:
+        if (closed_seq + 1) % self.checkpoint_frequency != 0:
             return
-        if not self.has_archives:
+        if not self.has_writable_archives:
             return
         publish_queue.queue_checkpoint(
-            self.app.database, closed_seq,
+            self.app.database,
+            closed_seq,
             self.app.bucket_manager.archive_state_json(closed_seq),
         )
         log.info("queued checkpoint at ledger %d", closed_seq)
 
     def publish_queued_history(self) -> None:
-        if not self.has_archives or self.publishing:
+        """Drain the publish queue one checkpoint at a time."""
+        if not self.has_writable_archives or self.publishing:
             return
-        # full publish state machine lands in history/publishsm.py
+        if getattr(self.app.database, "closed", False):
+            return  # app shut down while a publish-kick was queued
+        queued = publish_queue.queued_checkpoints(self.app.database)
+        if not queued:
+            return
+        seq, state_json = queued[0]
+        self.publishing = True
 
-    def catchup_history(self, init_ledger: int, mode: str, done_cb) -> None:
-        # full catchup state machine lands in history/catchupsm.py
-        raise NotImplementedError("catchup state machine not wired yet")
+        def done(ok: bool):
+            self.publishing = False
+            if ok:
+                self._publish_success += 1
+                publish_queue.dequeue_checkpoint(self.app.database, seq)
+                log.info("published checkpoint %d", seq)
+                # more may be queued (e.g. after catchup replay)
+                self.app.clock.post(self.publish_queued_history)
+            else:
+                self._publish_failure += 1
+                log.error("publishing checkpoint %d failed; will retry", seq)
+
+        PublishRun(self.app, seq, state_json, done).start()
+
+    # -- catchup -----------------------------------------------------------
+    def catchup_history(
+        self, mode: Optional[str] = None, done_cb: Callable = None
+    ) -> None:
+        """Start (or restart) the catchup FSM toward the newest archive
+        state.  ``done_cb(ok, anchor_header)`` defaults to the
+        LedgerManager's completion handler."""
+        if self.catchup is not None and self.catchup.state not in ("END", "FAILED"):
+            return  # already running
+        if mode is None:
+            mode = (
+                CATCHUP_COMPLETE
+                if self.app.config.CATCHUP_COMPLETE
+                else CATCHUP_MINIMAL
+            )
+        if done_cb is None:
+            done_cb = self.app.ledger_manager.catchup_finished
+        self.catchup = CatchupStateMachine(self.app, mode, done_cb)
+        self.catchup.begin()
+
+    def get_publish_success_count(self) -> int:
+        return self._publish_success
+
+    def get_publish_failure_count(self) -> int:
+        return self._publish_failure

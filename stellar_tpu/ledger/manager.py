@@ -142,8 +142,16 @@ class LedgerManager:
 
     # -- externalize path (LedgerManagerImpl.cpp:321-408) ------------------
     def externalize_value(self, ledger_data) -> None:
+        if self.state == LedgerState.LM_CATCHING_UP_STATE:
+            # keep buffering while the catchup FSM runs (:389-399)
+            self.syncing_ledgers.append(ledger_data)
+            return
         if ledger_data.ledger_seq == self.last_closed.header.ledgerSeq + 1:
             self.close_ledger(ledger_data)
+            if self.state == LedgerState.LM_BOOTING_STATE:
+                # a failed catchup round left us unsynced, but the network
+                # delivered the next ledger in order after all
+                self.state = LedgerState.LM_SYNCED_STATE
             self.app.herder_notify_ledger_closed()
         elif ledger_data.ledger_seq <= self.last_closed.header.ledgerSeq:
             log.debug("skipping old ledger %d", ledger_data.ledger_seq)
@@ -160,14 +168,66 @@ class LedgerManager:
     def start_catchup(self) -> None:
         self.state = LedgerState.LM_CATCHING_UP_STATE
         self.app.request_catchup()
+        self.app.history_manager.catchup_history()
+
+    def catchup_finished(self, ok: bool, anchor_lhe) -> None:
+        """CatchupStateMachine completion (LedgerManagerImpl::historyCaughtup)."""
+        if not ok:
+            log.error("catchup failed; will retry on next externalize gap")
+            self.state = LedgerState.LM_BOOTING_STATE
+            # drop buffered ledgers we can no longer use; keep future ones
+            self.syncing_ledgers = [
+                ld
+                for ld in self.syncing_ledgers
+                if ld.ledger_seq > self.last_closed.header.ledgerSeq
+            ]
+            return
+        if anchor_lhe.header.ledgerSeq > self.last_closed.header.ledgerSeq:
+            # catchup-minimal: jump the LCL to the anchor header
+            self._adopt_anchor_header(anchor_lhe)
+        self.history_caught_up()
+
+    def _adopt_anchor_header(self, lhe) -> None:
+        from ..main.persistentstate import (
+            K_HISTORY_ARCHIVE_STATE,
+            K_LAST_CLOSED_LEDGER,
+            PersistentState,
+        )
+
+        frame = LedgerHeaderFrame(lhe.header)
+        if frame.get_hash() != lhe.hash:
+            raise RuntimeError("anchor header hash mismatch")
+        if self.app.bucket_manager.get_hash() != lhe.header.bucketListHash:
+            raise RuntimeError("anchor bucket list hash mismatch")
+        with self.database.transaction():
+            frame.store_insert(self.database)
+            ps = PersistentState(self.database)
+            ps.set_state(K_LAST_CLOSED_LEDGER, lhe.hash.hex())
+            ps.set_state(
+                K_HISTORY_ARCHIVE_STATE,
+                self.app.bucket_manager.archive_state_json(lhe.header.ledgerSeq),
+            )
+        self.current = frame
+        self._advance_ledger_pointers()
+        log.info("caught up (minimal) to ledger %d", lhe.header.ledgerSeq)
 
     def history_caught_up(self) -> None:
         """Replay any buffered ledgers then flip to synced."""
-        for ld in sorted(self.syncing_ledgers, key=lambda l: l.ledger_seq):
+        self.state = LedgerState.LM_SYNCED_STATE
+        buffered = sorted(self.syncing_ledgers, key=lambda l: l.ledger_seq)
+        self.syncing_ledgers.clear()
+        still_ahead = []
+        for ld in buffered:
             if ld.ledger_seq == self.last_closed.header.ledgerSeq + 1:
                 self.close_ledger(ld)
-        self.syncing_ledgers.clear()
-        self.state = LedgerState.LM_SYNCED_STATE
+            elif ld.ledger_seq > self.last_closed.header.ledgerSeq:
+                still_ahead.append(ld)
+        if still_ahead:
+            # network moved past the archive anchor while we fetched:
+            # go around again (reference restarts the catchup round)
+            self.syncing_ledgers.extend(still_ahead)
+            self.start_catchup()
+            return
         self.app.herder_notify_ledger_closed()
 
     # -- THE close (LedgerManagerImpl.cpp:612-741) -------------------------

@@ -267,9 +267,19 @@ class CommandHandler:
         return {"status": "ok", "level": level, "partition": partition or "all"}
 
     def handle_catchup(self, q: dict) -> dict:
-        mode = q.get("mode", "minimal")
-        self.app.ledger_manager.start_catchup()
-        return {"status": "catching up", "mode": mode}
+        from ..history.catchupsm import CATCHUP_COMPLETE, CATCHUP_MINIMAL
+        from ..ledger.manager import LedgerState
+
+        mode = q.get("mode")
+        if mode not in (None, CATCHUP_MINIMAL, CATCHUP_COMPLETE):
+            raise ValueError(f"unknown catchup mode {mode!r}")
+        self.app.ledger_manager.state = LedgerState.LM_CATCHING_UP_STATE
+        self.app.request_catchup()
+        self.app.history_manager.catchup_history(mode=mode)
+        effective = mode or (
+            CATCHUP_COMPLETE if self.app.config.CATCHUP_COMPLETE else CATCHUP_MINIMAL
+        )
+        return {"status": "catching up", "mode": effective}
 
     def handle_maintenance(self, q: dict) -> dict:
         from .externalqueue import ExternalQueue

@@ -60,6 +60,9 @@ class Config:
         self.VALIDATOR_NAMES: Dict[str, str] = {}
         # history
         self.HISTORY: Dict[str, dict] = {}
+        # 64 in production (~5 min at 5s closes); tests accelerate to 8
+        # like the reference's accelerated-time mode
+        self.CHECKPOINT_FREQUENCY = 64
         # storage
         self.DATABASE = "sqlite3://:memory:"
         self.COMMANDS: List[str] = []

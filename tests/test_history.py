@@ -1,0 +1,225 @@
+"""History subsystem tests (modeled on reference src/history/HistoryTests.cpp):
+file-based archives in tmp dirs (get/put = cp templates), publish cycles,
+catchup in both modes, publish-failure retry."""
+
+import glob
+import os
+import shutil
+
+import pytest
+
+from stellar_tpu.history import publish as publish_queue
+from stellar_tpu.ledger.accountframe import AccountFrame
+from stellar_tpu.ledger.manager import LedgerState
+from stellar_tpu.main.application import Application
+from stellar_tpu.tx import testutils as T
+from stellar_tpu.util.clock import REAL_TIME, VirtualClock
+
+FREQ = 8  # accelerated checkpoint cadence, like the reference's test mode
+
+
+def archive_config(archive_dir: str, writable: bool) -> dict:
+    spec = {"get": f"cp {archive_dir}/{{0}} {{1}}"}
+    if writable:
+        spec["put"] = f"cp {{0}} {archive_dir}/{{1}}"
+        spec["mkdir"] = f"mkdir -p {archive_dir}/{{0}}"
+    return {"test": spec}
+
+
+def make_app(clock, instance, archive_dir, writable_archive):
+    cfg = T.get_test_config(instance)
+    cfg.CHECKPOINT_FREQUENCY = FREQ
+    cfg.HISTORY = archive_config(archive_dir, writable_archive)
+    cfg.CATCHUP_COMPLETE = True
+    shutil.rmtree(cfg.BUCKET_DIR_PATH, ignore_errors=True)
+    app = Application.create(clock, cfg, new_db=True)
+    app.start()
+    return app
+
+
+def close_one(app, clock, txs=()):
+    from stellar_tpu.herder.herder import TX_STATUS_PENDING
+
+    for tx in txs:
+        assert app.herder.recv_transaction(tx) == TX_STATUS_PENDING
+    target = app.ledger_manager.get_last_closed_ledger_num() + 1
+    app.herder.trigger_next_ledger(app.ledger_manager.get_ledger_num())
+    assert clock.crank_until(
+        lambda: app.ledger_manager.get_last_closed_ledger_num() >= target, 30
+    )
+
+
+def create_account_tx(app, dest, balance):
+    root = T.root_key_for(app)
+    frame = AccountFrame.load_account(root.get_public_key(), app.database)
+    seq = max(
+        frame.get_seq_num(),
+        app.herder.get_max_seq_in_pending_txs(root.get_public_key()),
+    )
+    return T.tx_from_ops(app, root, seq + 1, [T.create_account_op(dest, balance)])
+
+
+@pytest.fixture
+def fresh_archive(tmp_path):
+    d = tmp_path / "archive"
+    d.mkdir()
+    yield str(d)
+
+
+@pytest.fixture
+def clock():
+    c = VirtualClock(REAL_TIME)
+    yield c
+    c.shutdown()
+
+
+def publish_checkpoint(app, clock, accounts=()):
+    """Close ledgers (with some txs) through the next checkpoint boundary
+    and crank until it is published."""
+    start = app.history_manager.get_publish_success_count()
+    lm = app.ledger_manager
+    made = []
+    while True:
+        txs = []
+        if accounts:
+            dest = T.get_account(
+                f"hist-acct-{lm.get_last_closed_ledger_num()}-{app.config.HTTP_PORT}"
+            )
+            txs = [create_account_tx(app, dest, 200_000_000)]
+            made.append(dest)
+        close_one(app, clock, txs)
+        if (lm.get_last_closed_ledger_num() + 1) % FREQ == 0:
+            break
+    assert clock.crank_until(
+        lambda: app.history_manager.get_publish_success_count() > start, 30
+    )
+    return made
+
+
+def test_publish_creates_archive_files(clock, fresh_archive):
+    app = make_app(clock, 20, fresh_archive, writable_archive=True)
+    try:
+        publish_checkpoint(app, clock, accounts=True)
+        wk = os.path.join(fresh_archive, ".well-known/stellar-history.json")
+        assert os.path.exists(wk)
+        from stellar_tpu.history.archive import HistoryArchiveState
+
+        has = HistoryArchiveState.from_json(open(wk).read())
+        assert has.current_ledger == FREQ - 1
+        assert glob.glob(f"{fresh_archive}/ledger/00/00/00/ledger-*.xdr.gz")
+        assert glob.glob(f"{fresh_archive}/transactions/00/00/00/transactions-*.xdr.gz")
+        assert glob.glob(f"{fresh_archive}/results/00/00/00/results-*.xdr.gz")
+        assert glob.glob(f"{fresh_archive}/bucket/*/*/*/bucket-*.xdr.gz")
+        assert glob.glob(f"{fresh_archive}/history/00/00/00/history-*.json")
+        # publish queue drained
+        assert publish_queue.queued_checkpoints(app.database) == []
+    finally:
+        app.graceful_stop()
+
+
+def test_catchup_complete_replays_history(clock, fresh_archive):
+    app1 = make_app(clock, 21, fresh_archive, writable_archive=True)
+    try:
+        made = publish_checkpoint(app1, clock, accounts=True)
+        assert made
+        lcl1 = app1.ledger_manager.last_closed
+    finally:
+        app1.graceful_stop()
+
+    app2 = make_app(clock, 22, fresh_archive, writable_archive=False)
+    try:
+        app2.config.CATCHUP_COMPLETE = True
+        lm2 = app2.ledger_manager
+        lm2.start_catchup()
+        assert clock.crank_until(
+            lambda: lm2.state == LedgerState.LM_SYNCED_STATE, 60
+        )
+        assert lm2.get_last_closed_ledger_num() == FREQ - 1
+        # full replay: exact same chain...
+        assert lm2.last_closed.hash == lcl1.hash
+        # ...and the transactions really applied
+        for dest in made:
+            af = AccountFrame.load_account(dest.get_public_key(), app2.database)
+            assert af is not None and af.get_balance() == 200_000_000
+    finally:
+        app2.graceful_stop()
+
+
+def test_catchup_minimal_adopts_buckets(clock, fresh_archive):
+    app1 = make_app(clock, 23, fresh_archive, writable_archive=True)
+    try:
+        made = publish_checkpoint(app1, clock, accounts=True)
+        lcl1 = app1.ledger_manager.last_closed
+        bucket_hash1 = app1.bucket_manager.get_hash()
+    finally:
+        app1.graceful_stop()
+
+    app2 = make_app(clock, 24, fresh_archive, writable_archive=False)
+    try:
+        app2.config.CATCHUP_COMPLETE = False
+        lm2 = app2.ledger_manager
+        lm2.start_catchup()
+        assert clock.crank_until(
+            lambda: lm2.state == LedgerState.LM_SYNCED_STATE, 60
+        )
+        assert lm2.get_last_closed_ledger_num() == FREQ - 1
+        assert lm2.last_closed.hash == lcl1.hash
+        assert app2.bucket_manager.get_hash() == bucket_hash1
+        for dest in made:
+            af = AccountFrame.load_account(dest.get_public_key(), app2.database)
+            assert af is not None and af.get_balance() == 200_000_000
+        # the caught-up node keeps closing ledgers
+        close_one(app2, clock)
+        assert lm2.get_last_closed_ledger_num() == FREQ
+    finally:
+        app2.graceful_stop()
+
+
+def test_publish_failure_retries_from_queue(clock, fresh_archive):
+    app = make_app(clock, 25, fresh_archive, writable_archive=True)
+    try:
+        # break the archive: puts will fail, the queue must keep the row
+        app.config.HISTORY["test"]["put"] = "false"
+        lm = app.ledger_manager
+        while (lm.get_last_closed_ledger_num() + 1) % FREQ != 0:
+            close_one(app, clock)
+        close_one(app, clock)
+        assert clock.crank_until(
+            lambda: app.history_manager.get_publish_failure_count() > 0, 30
+        )
+        assert len(publish_queue.queued_checkpoints(app.database)) == 1
+        # repair the archive and drain the queue
+        app.config.HISTORY["test"]["put"] = f"cp {{0}} {fresh_archive}/{{1}}"
+        app.history_manager.publish_queued_history()
+        assert clock.crank_until(
+            lambda: app.history_manager.get_publish_success_count() > 0, 30
+        )
+        assert publish_queue.queued_checkpoints(app.database) == []
+    finally:
+        app.graceful_stop()
+
+
+def test_second_checkpoint_and_catchup_across_two(clock, fresh_archive):
+    """Publish two checkpoints; a fresh node catches up across both."""
+    app1 = make_app(clock, 26, fresh_archive, writable_archive=True)
+    try:
+        publish_checkpoint(app1, clock, accounts=True)
+        made2 = publish_checkpoint(app1, clock, accounts=True)
+        lcl1 = app1.ledger_manager.last_closed
+        assert lcl1.header.ledgerSeq == 2 * FREQ - 1
+    finally:
+        app1.graceful_stop()
+
+    app2 = make_app(clock, 27, fresh_archive, writable_archive=False)
+    try:
+        lm2 = app2.ledger_manager
+        lm2.start_catchup()
+        assert clock.crank_until(
+            lambda: lm2.state == LedgerState.LM_SYNCED_STATE, 60
+        )
+        assert lm2.get_last_closed_ledger_num() == 2 * FREQ - 1
+        assert lm2.last_closed.hash == lcl1.hash
+        for dest in made2:
+            assert AccountFrame.load_account(dest.get_public_key(), app2.database)
+    finally:
+        app2.graceful_stop()
