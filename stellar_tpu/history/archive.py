@@ -117,6 +117,20 @@ class HistoryArchiveState:
         st.version = d.get("version", HISTORY_ARCHIVE_STATE_VERSION)
         return st
 
+    def bucket_list_hash(self) -> bytes:
+        """The bucketListHash this state reconstructs to, computed from
+        hashes alone (BucketList::getHash shape: H(concat H(curr‖snap))) —
+        lets catchup validate an archive BEFORE adopting anything."""
+        from ..crypto import SHA256
+
+        outer = SHA256()
+        for lev in self.current_buckets:
+            inner = SHA256()
+            inner.add(lev.curr)
+            inner.add(lev.snap)
+            outer.add(inner.finish())
+        return outer.finish()
+
     def all_bucket_hashes(self) -> List[bytes]:
         """Every nonzero bucket hash referenced (incl. future inputs/outputs)."""
         out: List[bytes] = []

@@ -128,9 +128,9 @@ class CatchupStateMachine:
             )
         else:
             # every checkpoint covering (lcl, anchor]
-            first_cp = ((lcl + 1) // freq + 1) * freq - 1
-            # the checkpoint containing lcl+1 may be the one at/before that
-            start_cp = min(first_cp, anchor)
+            from .manager import checkpoint_containing_ledger
+
+            start_cp = min(checkpoint_containing_ledger(lcl + 1, freq), anchor)
             checkpoints = list(range(start_cp, anchor + 1, freq))
             if checkpoints and checkpoints[-1] != anchor:
                 checkpoints.append(anchor)
@@ -270,6 +270,14 @@ class CatchupStateMachine:
         from ..bucket.bucket import ZERO_HASH
         from ..crypto import SHA256
 
+        # validate BEFORE any destructive step: the HAS must reconstruct
+        # the anchor header's bucketListHash, or this archive is lying and
+        # we must retry without having wiped anything
+        anchor = self.headers[self.has.current_ledger]
+        if self.has.bucket_list_hash() != anchor.header.bucketListHash:
+            raise RuntimeError(
+                "archive bucket list does not hash to the anchor header"
+            )
         bm = self.app.bucket_manager
         for fi in files:
             if fi.category != "bucket":

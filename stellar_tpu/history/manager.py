@@ -63,15 +63,23 @@ class HistoryManager:
         )
         log.info("queued checkpoint at ledger %d", closed_seq)
 
-    def publish_queued_history(self) -> None:
-        """Drain the publish queue one checkpoint at a time."""
+    def publish_queued_history(self) -> int:
+        """Drain the publish queue one checkpoint at a time; returns how
+        many checkpoints are queued (reference publishQueuedHistory
+        returns the count kicked off)."""
         if not self.has_writable_archives or self.publishing:
-            return
+            return 0
         if getattr(self.app.database, "closed", False):
-            return  # app shut down while a publish-kick was queued
+            return 0  # app shut down while a publish-kick was queued
+        from ..ledger.manager import LedgerState
+
+        if self.app.ledger_manager.state == LedgerState.LM_CATCHING_UP_STATE:
+            # replaying history re-queues old checkpoints; publishing them
+            # now would regress the archive root state — drain after catchup
+            return 0
         queued = publish_queue.queued_checkpoints(self.app.database)
         if not queued:
-            return
+            return 0
         seq, state_json = queued[0]
         self.publishing = True
 
@@ -88,6 +96,7 @@ class HistoryManager:
                 log.error("publishing checkpoint %d failed; will retry", seq)
 
         PublishRun(self.app, seq, state_json, done).start()
+        return len(queued)
 
     # -- catchup -----------------------------------------------------------
     def catchup_history(

@@ -38,7 +38,6 @@ from .filetransfer import (
     CAT_RESULTS,
     CAT_TRANSACTIONS,
     FileTransferInfo,
-    checkpoint_hex,
 )
 
 log = xlog.logger("History")
@@ -132,7 +131,11 @@ class PublishRun:
         self.files: List[FileTransferInfo] = []
         self._failed = False
 
-    # phase 1+2: snapshot + compress everything once
+    # phase 1+2: snapshot + compress everything once.  The SQL→XDR pass
+    # runs on the main crank because the sqlite session is single-threaded
+    # (an in-memory DB has no second connection); it covers only one
+    # checkpoint range.  The heavy work — bucket staging (hard links) and
+    # compression/transfer (subprocesses) — never blocks the crank.
     def start(self) -> None:
         try:
             self.files = write_checkpoint_snapshot(
@@ -271,17 +274,25 @@ class _ArchivePublisher:
 
     def _commit(self) -> None:
         """Write the per-checkpoint state file then the root .well-known."""
+        from .archive import remote_checkpoint_name
+
         local = os.path.join(
             self.run.tmp.get_name(), f"commit-{self.archive.name}.json"
         )
         with open(local, "w") as f:
             f.write(self.run.state_json)
-        h = checkpoint_hex(self.run.seq)
-        cp_remote = f"history/{h[0:2]}/{h[2:4]}/{h[4:6]}/history-{h}.json"
+        cp_remote = remote_checkpoint_name("history", self.run.seq, ".json")
 
         def after_cp(rc):
             if rc != 0:
                 self.done(False)
+                return
+            if (
+                self.remote_state is not None
+                and self.remote_state.current_ledger >= self.run.seq
+            ):
+                # never regress the archive root (e.g. replay republish)
+                self.done(True)
                 return
             self._put(
                 local,

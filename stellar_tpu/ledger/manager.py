@@ -165,10 +165,10 @@ class LedgerManager:
             self.syncing_ledgers.append(ledger_data)
             self.start_catchup()
 
-    def start_catchup(self) -> None:
+    def start_catchup(self, mode: Optional[str] = None) -> None:
         self.state = LedgerState.LM_CATCHING_UP_STATE
         self.app.request_catchup()
-        self.app.history_manager.catchup_history()
+        self.app.history_manager.catchup_history(mode=mode)
 
     def catchup_finished(self, ok: bool, anchor_lhe) -> None:
         """CatchupStateMachine completion (LedgerManagerImpl::historyCaughtup)."""
@@ -228,6 +228,8 @@ class LedgerManager:
             self.syncing_ledgers.extend(still_ahead)
             self.start_catchup()
             return
+        # drain any checkpoints the replay queued, now that we're synced
+        self.app.clock.post(self.app.history_manager.publish_queued_history)
         self.app.herder_notify_ledger_closed()
 
     # -- THE close (LedgerManagerImpl.cpp:612-741) -------------------------

@@ -268,18 +268,14 @@ class CommandHandler:
 
     def handle_catchup(self, q: dict) -> dict:
         from ..history.catchupsm import CATCHUP_COMPLETE, CATCHUP_MINIMAL
-        from ..ledger.manager import LedgerState
 
         mode = q.get("mode")
         if mode not in (None, CATCHUP_MINIMAL, CATCHUP_COMPLETE):
             raise ValueError(f"unknown catchup mode {mode!r}")
-        self.app.ledger_manager.state = LedgerState.LM_CATCHING_UP_STATE
-        self.app.request_catchup()
-        self.app.history_manager.catchup_history(mode=mode)
-        effective = mode or (
-            CATCHUP_COMPLETE if self.app.config.CATCHUP_COMPLETE else CATCHUP_MINIMAL
-        )
-        return {"status": "catching up", "mode": effective}
+        self.app.ledger_manager.start_catchup(mode)
+        # report what is ACTUALLY running (an in-flight run is kept as-is)
+        fsm = self.app.history_manager.catchup
+        return {"status": "catching up", "mode": fsm.mode, "state": fsm.state}
 
     def handle_maintenance(self, q: dict) -> dict:
         from .externalqueue import ExternalQueue

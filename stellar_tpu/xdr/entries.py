@@ -133,7 +133,9 @@ class _Ext0Codec(int32.__class__):
     """The ubiquitous reserved `union switch (int v) { case 0: void; } ext`."""
 
     def pack_into(self, val, out):
-        super().pack_into(0 if val is None else int(val), out)
+        # reserved arm: always writes 0 regardless of the field value, so a
+        # stray in-memory value can never produce undecodable bytes
+        super().pack_into(0, out)
 
     def unpack_from(self, buf, off):
         v, off = super().unpack_from(buf, off)
