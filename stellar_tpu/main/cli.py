@@ -46,6 +46,8 @@ def _print_id_representations(arg: str) -> int:
     except Exception:
         try:
             raw = bytes.fromhex(arg)
+            if len(raw) != 32:
+                raise ValueError("hex id must be 32 bytes")
             out["hex"] = arg
             out["account strkey"] = strkey.to_account_strkey(raw)
         except Exception:
@@ -196,6 +198,7 @@ def main(argv=None) -> int:
     from .config import Config
 
     conf_path = "stellar-tpu.cfg"
+    conf_explicit = False
     cmds = []
     metrics = []
     log_level = "info"
@@ -221,6 +224,7 @@ def main(argv=None) -> int:
             return 0
         elif a == "--conf":
             conf_path = take()
+            conf_explicit = True
         elif a == "--c":
             cmds.append(take())
         elif a == "--ll":
@@ -276,6 +280,10 @@ def main(argv=None) -> int:
 
     if os.path.exists(conf_path):
         cfg = Config.load(conf_path)
+    elif conf_explicit:
+        # a typo'd --conf must never silently boot a default-network node
+        print(f"config file {conf_path!r} not found", file=sys.stderr)
+        return 1
     else:
         print(f"no config file {conf_path!r}, using defaults", file=sys.stderr)
         cfg = Config()

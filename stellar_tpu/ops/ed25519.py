@@ -217,21 +217,23 @@ def _build_a_table(neg_a):
 # ---------------------------------------------------------------------------
 
 
-def verify_kernel(a_y_limbs, a_sign, r_bytes, s_nibs, h_nibs):
+def verify_kernel(a_bytes, r_bytes, s_nibs, h_nibs):
     """All-device batched check R' == R.
 
-    a_y_limbs (20,N) — y limbs of A (sign already stripped)
-    a_sign    (N,)   — sign bit of A's encoding
+    a_bytes   (32,N) — public key A bytes (little-endian, sign in bit 255)
     r_bytes   (32,N) — signature R bytes (to compare against)
     s_nibs    (64,N) — s scalar nibbles, little-endian
     h_nibs    (64,N) — h = SHA512(R‖A‖M) mod L nibbles, little-endian
     returns   (N,) bool
     """
+    a_sign = a_bytes[31] >> 7
+    a_masked = a_bytes.at[31].set(a_bytes[31] & 0x7F)
+    a_y_limbs = fe.limbs_from_bytes(a_masked)
     a_pt, fail = decompress(a_y_limbs, a_sign)
     neg_a = point_negate(a_pt)
     a_table = _build_a_table(neg_a)
 
-    n = a_y_limbs.shape[1]
+    n = a_bytes.shape[1]
 
     def body(i, acc):
         t = WINDOWS - 1 - i
@@ -286,7 +288,7 @@ class BatchVerifier:
             vec = NamedSharding(self.mesh, PSpec(batch_axis))
             kern = jax.jit(
                 verify_kernel,
-                in_shardings=(shard, vec, shard, shard, shard),
+                in_shardings=(shard, shard, shard, shard),
                 out_shardings=vec,
             )
             return kern
@@ -310,14 +312,23 @@ class BatchVerifier:
             else:
                 self.n_gate_rejects += 1
         self.n_items += len(items)
+        # dispatch every chunk before syncing any: jit calls are async, so
+        # host staging of chunk k+1 overlaps device compute of chunk k
+        pending = []
+        t0 = time.perf_counter()
         for start in range(0, len(todo), self.max_batch):
             chunk = todo[start : start + self.max_batch]
-            results = self._run_chunk(chunk)
+            pending.append((chunk, self._dispatch_chunk(chunk)))
+        for chunk, fut in pending:
+            results = np.asarray(fut)[: len(chunk)]
             for (i, *_), ok in zip(chunk, results):
                 out[i] = bool(ok)
+        if pending:
+            # dispatch + device compute + sync for the whole call
+            self.device_seconds += time.perf_counter() - t0
         return out
 
-    def _run_chunk(self, chunk) -> np.ndarray:
+    def _dispatch_chunk(self, chunk):
         n = len(chunk)
         if n == 0:
             return np.zeros(0, dtype=bool)
@@ -326,34 +337,27 @@ class BatchVerifier:
         r_bytes = np.zeros((bucket, 32), dtype=np.uint8)
         s_bytes = np.zeros((bucket, 32), dtype=np.uint8)
         h_bytes = np.zeros((bucket, 32), dtype=np.uint8)
+        # bulk staging: one frombuffer per column set, not one per item
+        a_bytes[:n] = np.frombuffer(
+            b"".join(pk for _, pk, _, _ in chunk), dtype=np.uint8
+        ).reshape(n, 32)
+        sigs = np.frombuffer(
+            b"".join(sig for _, _, _, sig in chunk), dtype=np.uint8
+        ).reshape(n, 64)
+        r_bytes[:n] = sigs[:, :32]
+        s_bytes[:n] = sigs[:, 32:]
+        sha = hashlib.sha512
         for j, (_, pk, msg, sig) in enumerate(chunk):
-            a_bytes[j] = np.frombuffer(pk, dtype=np.uint8)
-            r_bytes[j] = np.frombuffer(sig[:32], dtype=np.uint8)
-            s_bytes[j] = np.frombuffer(sig[32:], dtype=np.uint8)
-            h = (
-                int.from_bytes(
-                    hashlib.sha512(sig[:32] + pk + msg).digest(), "little"
-                )
-                % L
-            )
+            h = int.from_bytes(sha(sig[:32] + pk + msg).digest(), "little") % L
             h_bytes[j] = np.frombuffer(h.to_bytes(32, "little"), dtype=np.uint8)
-        sign = (a_bytes[:, 31] >> 7).astype(np.int32)
-        a_masked = a_bytes.copy()
-        a_masked[:, 31] &= 0x7F
-        a_cols = np.ascontiguousarray(a_masked.T).astype(np.int32)  # (32, B)
-        y_limbs = fe.limbs_from_bytes(jnp.asarray(a_cols))
-        t0 = time.perf_counter()
         ok = self._kernel(
-            y_limbs,
-            jnp.asarray(sign),
+            jnp.asarray(np.ascontiguousarray(a_bytes.T).astype(np.int32)),
             jnp.asarray(np.ascontiguousarray(r_bytes.T).astype(np.int32)),
             jnp.asarray(_nibbles_np(s_bytes)),
             jnp.asarray(_nibbles_np(h_bytes)),
         )
-        ok = np.asarray(ok)
-        self.device_seconds += time.perf_counter() - t0
         self.n_device_calls += 1
-        return ok[:n]
+        return ok
 
     def stats(self) -> dict:
         return {

@@ -54,26 +54,76 @@ def const_fe(v: int) -> jnp.ndarray:
 TWO_P_LIMBS = jnp.asarray(int_to_limbs(_TWO_P)).reshape(LIMBS, 1)
 
 
+def _sub_pad_limbs() -> np.ndarray:
+    """4p written with every limb >= 2^14 (limb 19 >= 2^9): ``a - b + pad``
+    then has all-positive limbs for weakly-reduced a, b, so the parallel
+    carry passes never ripple borrows.  Built by borrowing 2 units of each
+    limb's radix from the limb above (value preserved)."""
+    four_p = 4 * P
+    l = np.zeros(LIMBS, dtype=np.int64)
+    v = four_p
+    for i in range(LIMBS):
+        l[i] = v & MASK if i < LIMBS - 1 else v
+        v >>= RADIX
+    assert l[LIMBS - 1] >= 2 + 512, l[LIMBS - 1]  # room to borrow 2
+    d = l.copy()
+    d[0] += 2 << RADIX
+    for i in range(1, LIMBS - 1):
+        d[i] += (2 << RADIX) - 2
+    d[LIMBS - 1] -= 2
+    assert sum(int(d[i]) << (RADIX * i) for i in range(LIMBS)) == four_p
+    assert all(d[i] >= 2 * MASK for i in range(LIMBS - 1)) and d[LIMBS - 1] >= 512
+    return d.astype(np.int32)
+
+
+SUB_PAD = jnp.asarray(_sub_pad_limbs()).reshape(LIMBS, 1)
+
+
 def zero_like(x):
     return jnp.zeros_like(x)
 
 
-def carry(x: jnp.ndarray) -> jnp.ndarray:
-    """Sequential carry chain + top-limb fold -> weakly reduced.
+def _carry_pass(x: jnp.ndarray) -> jnp.ndarray:
+    """ONE data-parallel carry pass over all limbs at once.
 
-    Accepts limbs anywhere in int32 (including negatives, e.g. after sub);
-    arithmetic shifts make the carries floor-divide correctly.
+    The sequential 19-step chain was the kernel's critical path (each step
+    a tiny dependent (N,) op); a pass is ~6 full-(20,N) ops with depth 3.
+    Limbs 0..18 carry at 2^13; limb 19 holds bits 247..254 and folds its
+    overflow back to limb 0 via 2^255 ≡ 19 (mod p).  Arithmetic shifts
+    floor-divide, so negative limbs borrow correctly.
     """
+    c_lo = x[:-1] >> RADIX
+    r_lo = x[:-1] - (c_lo << RADIX)
+    c_hi = x[-1] >> 8
+    r_hi = x[-1] - (c_hi << 8)
+    carries = jnp.concatenate([(c_hi * 19)[None], c_lo], axis=0)
+    return jnp.concatenate([r_lo, r_hi[None]], axis=0) + carries
+
+
+def carry(x: jnp.ndarray, passes: int = 3) -> jnp.ndarray:
+    """Parallel carry -> weakly reduced (limbs <= 2^13 + 3).
+
+    Pass-count bounds (see the mul/add/sub callers): products after the
+    fold have limbs < 2^31 -> 3 passes leave every limb <= MASK + 3;
+    add/sub inputs <= 2^14.6 need only 2.
+    """
+    for _ in range(passes):
+        x = _carry_pass(x)
+    return x
+
+
+def carry_exact(x: jnp.ndarray) -> jnp.ndarray:
+    """Sequential full chain: limbs land exactly in [0, 2^13) (limb 19 in
+    [0, 2^8)).  O(limbs) dependent steps — only for ``canonical`` (a few
+    calls per verify); the hot path uses the parallel ``carry``."""
     limbs = [x[i] for i in range(LIMBS)]
     for i in range(LIMBS - 1):
         c = limbs[i] >> RADIX
         limbs[i] = limbs[i] - (c << RADIX)
         limbs[i + 1] = limbs[i + 1] + c
-    # fold bits >= 255 of the top limb (limb 19 holds bits 247..): 2^255 ≡ 19
     t = limbs[LIMBS - 1] >> 8
     limbs[LIMBS - 1] = limbs[LIMBS - 1] & 0xFF
     limbs[0] = limbs[0] + t * 19
-    # short re-carry (t*19 < 2^23)
     for i in range(2):
         c = limbs[i] >> RADIX
         limbs[i] = limbs[i] - (c << RADIX)
@@ -87,38 +137,44 @@ def _bcast(c, x):
 
 
 def add(a, b):
-    return carry(a + b)
+    # both weakly reduced (<= MASK+3): sums <= 2^14, 2 passes suffice
+    return carry(a + b, passes=2)
 
 
 def sub(a, b):
-    # a - b + 2p stays positive for weakly-reduced inputs
-    return carry(a - b + _bcast(TWO_P_LIMBS, a))
+    # a - b + pad: pad has every limb >= 2^13+ε, so limbs stay positive in
+    # [~8150, 3*2^13] — no borrow ripple, 2 passes suffice
+    return carry(a - b + _bcast(SUB_PAD, a), passes=2)
 
 
 def neg(a):
-    return carry(_bcast(TWO_P_LIMBS, a) - a)
+    return carry(_bcast(SUB_PAD, a) - a, passes=2)
 
 
 def mul(a, b):
-    """Full schoolbook multiply + fold + carry.  a, b weakly reduced."""
+    """Schoolbook multiply + parallel fold + carry.
+
+    Inputs weakly reduced (limbs <= ~2^13): every product column is
+    < 20·(2^13+3)^2 < 2^31, so sums stay in int32.  (Tree-structured and
+    grouped accumulation variants were measured on the axon TPU relay:
+    both blew compile time through the roof; the plain accumulate loop
+    fuses fine.)  The 19 high limbs fold back with 2^260 ≡ 608 (mod p),
+    split into a low part (<= MASK, ×608 <= 2^22.3) and a carry part
+    (<= 2^17.7, ×608 <= 2^27.3, shifted one limb up) so the fold
+    multiplies can't overflow either.
+    """
     n = a.shape[1:]
     prod = jnp.zeros((2 * LIMBS - 1,) + n, dtype=jnp.int32)
     for j in range(LIMBS):
         prod = prod.at[j : j + LIMBS].add(a * b[j][None])
     lo = prod[:LIMBS]
     hi = prod[LIMBS:]  # 19 limbs, each < 2^31
-    # normalize hi so the fold multiplications stay in int32
-    hlimbs = [hi[i] for i in range(LIMBS - 1)]
-    for i in range(LIMBS - 2):
-        c = hlimbs[i] >> RADIX
-        hlimbs[i] = hlimbs[i] - (c << RADIX)
-        hlimbs[i + 1] = hlimbs[i + 1] + c
-    htop = hlimbs[LIMBS - 2] >> RADIX  # final carry-out (< 2^18)
-    hlimbs[LIMBS - 2] = hlimbs[LIMBS - 2] - (htop << RADIX)
-    hi_n = jnp.stack(hlimbs)
-    lo = lo.at[: LIMBS - 1].add(hi_n * FOLD)
-    lo = lo.at[LIMBS - 1].add(htop * FOLD)
-    return carry(lo)
+    hi_lo = hi & MASK
+    hi_hi = hi >> RADIX
+    zero = jnp.zeros((1,) + n, dtype=jnp.int32)
+    lo = lo.at[: LIMBS - 1].add(hi_lo * FOLD)
+    lo = lo + jnp.concatenate([zero, hi_hi * FOLD], axis=0)
+    return carry(lo, passes=3)
 
 
 def sqr(a):
@@ -172,7 +228,7 @@ def pow_p58(z):
 
 def canonical(x):
     """Weakly-reduced -> fully reduced (< p), canonical limbs."""
-    x = carry(x)
+    x = carry_exact(x)
     # weakly reduced: x < p + ε < 2p, so at most one subtraction of p.
     # lexicographic compare with p from the top limb down: x >= p?
     p_limbs = int_to_limbs(P)
@@ -184,7 +240,7 @@ def canonical(x):
         eq_so_far = eq_so_far & (x[i] == pi)
     need_sub = gt | eq_so_far
     sub_p = _bcast(jnp.asarray(int_to_limbs(P)).reshape(LIMBS, 1), x)
-    return carry(x - jnp.where(need_sub[None], sub_p, 0))
+    return carry_exact(x - jnp.where(need_sub[None], sub_p, 0))
 
 
 def eq(a, b):
