@@ -34,6 +34,8 @@ class Database:
         self._tx_depth = 0
         self._sp_counter = 0
         self.excluded_time = 0.0  # DBTimeExcluder support
+        self.query_count = 0
+        self.closed = False
 
     @staticmethod
     def _parse(cs: str) -> str:
@@ -42,16 +44,21 @@ class Database:
         raise ValueError(f"unsupported DATABASE connection string: {cs}")
 
     # -- raw access --------------------------------------------------------
+    # query_count feeds per-peer load attribution (overlay LoadManager)
     def execute(self, sql: str, params: Iterable = ()) -> sqlite3.Cursor:
+        self.query_count += 1
         return self._conn.execute(sql, tuple(params))
 
     def executemany(self, sql: str, rows) -> sqlite3.Cursor:
+        self.query_count += 1
         return self._conn.executemany(sql, rows)
 
     def query_one(self, sql: str, params: Iterable = ()) -> Optional[Tuple]:
+        self.query_count += 1
         return self._conn.execute(sql, tuple(params)).fetchone()
 
     def query_all(self, sql: str, params: Iterable = ()) -> List[Tuple]:
+        self.query_count += 1
         return self._conn.execute(sql, tuple(params)).fetchall()
 
     # -- timed access (reference: getSelect/Insert/Update/DeleteTimer) ------

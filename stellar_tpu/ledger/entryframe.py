@@ -158,6 +158,21 @@ def store_add_or_change(entry: LedgerEntry, delta, db) -> None:
         frame.store_add(delta, db)
 
 
+def load_entry_by_key(key: LedgerKey, db) -> Optional["EntryFrame"]:
+    """Load whatever frame the key identifies, or None."""
+    from .accountframe import AccountFrame
+    from .offerframe import OfferFrame
+    from .trustframe import TrustFrame
+
+    if key.type == LedgerEntryType.ACCOUNT:
+        return AccountFrame.load_account(key.value.accountID, db)
+    if key.type == LedgerEntryType.TRUSTLINE:
+        return TrustFrame.load_trust_line(key.value.accountID, key.value.asset, db)
+    if key.type == LedgerEntryType.OFFER:
+        return OfferFrame.load_offer(key.value.sellerID, key.value.offerID, db)
+    raise ValueError(f"unknown ledger entry type {key.type}")
+
+
 def store_delete_key(key: LedgerKey, delta, db) -> None:
     """Delete by LedgerKey regardless of whether the row exists
     (reference: EntryFrame::storeDelete(delta, db, key))."""

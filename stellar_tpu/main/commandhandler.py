@@ -44,6 +44,7 @@ class CommandHandler:
             "dropcursor": self.handle_dropcursor,
             "setcursor": self.handle_setcursor,
             "checkpoint": self.handle_checkpoint,
+            "checkdb": lambda q: self.app.bucket_manager.check_db(),
             "generateload": self.handle_generateload,
             "logrotate": lambda q: {"status": "ok"},
         }
@@ -218,7 +219,11 @@ class CommandHandler:
 
     def handle_peers(self, q: dict) -> dict:
         om = self.app.overlay_manager
-        return om.dump_info() if om else {"peers": []}
+        if om is None:
+            return {"peers": []}
+        out = om.dump_info()
+        out["loads"] = om.load_manager.report_loads()
+        return out
 
     def handle_scp(self, q: dict) -> dict:
         h = self.app.herder

@@ -27,8 +27,6 @@ RADIX = 13
 MASK = (1 << RADIX) - 1  # 8191
 FOLD = 608  # 2^260 mod p = 19 * 2^5
 
-# 2*p in limb form, used as the additive pad for subtraction
-_TWO_P = 2 * P
 
 
 def int_to_limbs(v: int) -> np.ndarray:
@@ -49,9 +47,6 @@ def limbs_to_int(l) -> int:
 def const_fe(v: int) -> jnp.ndarray:
     """(20, 1) broadcastable constant."""
     return jnp.asarray(int_to_limbs(v % P)).reshape(LIMBS, 1)
-
-
-TWO_P_LIMBS = jnp.asarray(int_to_limbs(_TWO_P)).reshape(LIMBS, 1)
 
 
 def _sub_pad_limbs() -> np.ndarray:

@@ -38,6 +38,9 @@ class OverlayManager:
         self.tx_set_fetcher = ItemFetcher(app, lambda p, h: p.send_get_tx_set(h))
         self.qset_fetcher = ItemFetcher(app, lambda p, h: p.send_get_quorum_set(h))
         self.m_connections = app.metrics.new_counter(("overlay", "connection", "count"))
+        from .loadmanager import LoadManager
+
+        self.load_manager = LoadManager(app)
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
@@ -94,6 +97,7 @@ class OverlayManager:
                 if (pr.ip, pr.port) in connected:
                     continue
                 self.connect_to(pr)
+        self.load_manager.maybe_shed_excess_load()
         self.tick_timer.expires_from_now(TICK_SECONDS)
         self.tick_timer.async_wait(self.tick)
 

@@ -204,7 +204,11 @@ class Peer:
             self.send_mac_seq += 1
             amsg = AuthenticatedMessage.v0_of(seq, msg, mac)
         self._m_sent.mark()
-        self.send_frame(amsg.to_xdr())
+        frame = amsg.to_xdr()
+        lm = getattr(self.app.overlay_manager, "load_manager", None)
+        if lm is not None and self.peer_id is not None:
+            lm.get_peer_costs(bytes(self.peer_id.value)).bytes_send += len(frame)
+        self.send_frame(frame)
 
     # -- inbound ------------------------------------------------------------
     def recv_frame(self, data: bytes) -> None:
@@ -214,7 +218,16 @@ class Peer:
             log.warning("bad frame from %r: %s", self, e)
             self.drop()
             return
-        self.recv_authenticated_message(amsg)
+        # attribute processing cost + bytes to this peer (LoadManager)
+        lm = getattr(self.app.overlay_manager, "load_manager", None)
+        node = bytes(self.peer_id.value) if self.peer_id is not None else None
+        if lm is None:
+            self.recv_authenticated_message(amsg)
+            return
+        with lm.peer_context(node):
+            if node is not None:
+                lm.get_peer_costs(node).bytes_recv += len(data)
+            self.recv_authenticated_message(amsg)
 
     def recv_authenticated_message(self, amsg: AuthenticatedMessage) -> None:
         """Sequence + MAC check once keys exist (Peer.cpp:522-543)."""
