@@ -151,7 +151,17 @@ class Bucket:
     ) -> "Bucket":
         """Single-pass merge: new wins over old on identity collision; any
         entry present in a shadow (younger level) is elided; DEADENTRYs are
-        dropped entirely when ``keep_dead_entries`` is false (bottom level)."""
+        dropped entirely when ``keep_dead_entries`` is false (bottom level).
+
+        File-backed inputs run through the native C engine (GIL-free on
+        worker threads, bit-identical output — tests/test_native_merge.py);
+        anything else falls back to the Python path."""
+        shadows = list(shadows)
+        native_result = _try_native_merge(
+            bucket_manager, old_bucket, new_bucket, shadows, keep_dead_entries
+        )
+        if native_result is not None:
+            return native_result
         shadow_iters = [_Peekable(iter(s)) for s in shadows]
         return _write_merged(
             bucket_manager,
@@ -160,6 +170,35 @@ class Bucket:
             shadow_iters,
             keep_dead_entries,
         )
+
+
+def _try_native_merge(
+    bucket_manager, old_bucket, new_bucket, shadows, keep_dead_entries
+):
+    """Run the merge in C if every participant is file-backed (or empty).
+    Returns the merged Bucket, or None to fall back to Python."""
+    from .. import native
+
+    def path_of(b):
+        if b.is_empty():
+            return ""
+        return b.path if b.path and os.path.exists(b.path) else None
+
+    paths = [path_of(b) for b in (old_bucket, new_bucket, *shadows)]
+    if any(p is None for p in paths):
+        return None
+    tmp = os.path.join(
+        bucket_manager.get_tmp_dir(), f"tmp-bucket-{uuid.uuid4().hex}.xdr"
+    )
+    res = native.merge_files(paths[0], paths[1], paths[2:], keep_dead_entries, tmp)
+    if res is None:
+        return None
+    h, count = res
+    if count == 0:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        return Bucket()
+    return bucket_manager.adopt_file_as_bucket(tmp, h, count)
 
 
 def _write_merged(

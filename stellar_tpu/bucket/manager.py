@@ -31,6 +31,8 @@ class BucketManager:
         self.bucket_list = BucketList()
         self._buckets: Dict[bytes, Bucket] = {}
         self._lock = threading.Lock()
+        self.last_checkdb: Optional[dict] = None
+        self._checkdb_run = None
         # NB: must NOT live under TMP_DIR_PATH — that root is wiped on app
         # construction, and buckets must survive restart (merge resume).
         self.bucket_dir = os.path.abspath(app.config.BUCKET_DIR_PATH)
@@ -177,6 +179,19 @@ class BucketManager:
             "offers": counts[LedgerEntryType.OFFER],
         }
 
+    def start_check_db_async(self, batch: int = 2000) -> dict:
+        """Cooperative audit for the admin API: one bucket (then one
+        ``batch`` of SQL comparisons) per crank, so the reactor keeps
+        serving SCP and peers during a long scan.  Aborts if a ledger
+        closes mid-audit (the snapshot would no longer be consistent).
+        Result lands in ``self.last_checkdb``."""
+        if getattr(self, "_checkdb_run", None) is not None:
+            return {"status": "running", **self._checkdb_run.progress()}
+        run = _CheckDBRun(self, batch)
+        self._checkdb_run = run
+        self.app.clock.post(run.step)
+        return {"status": "started"}
+
     # -- GC (BucketManagerImpl::forgetUnreferencedBuckets) -----------------
     def referenced_hashes(self) -> set:
         refs = set()
@@ -215,3 +230,114 @@ class BucketManager:
                             os.unlink(b.path)
                     except OSError:
                         pass
+
+
+class _CheckDBRun:
+    """Incremental checkdb: replays one bucket per crank into the live map,
+    then compares SQL rows in batches; consistency guarded by aborting if
+    the LCL moves (the reference gets isolation from worker-thread DB
+    snapshots instead — sqlite in-process has no second session)."""
+
+    def __init__(self, bm: BucketManager, batch: int):
+        from ..ledger.entryframe import entry_cache_of
+
+        self.bm = bm
+        self.app = bm.app
+        self.batch = batch
+        self.start_lcl = self.app.ledger_manager.last_closed.header.ledgerSeq
+        self.buckets = [
+            b
+            for lev in reversed(bm.bucket_list.levels)
+            for b in (lev.snap, lev.curr)
+        ]
+        self.state: Dict[bytes, object] = {}
+        self.items = None  # iterator over final state, set after replay
+        self.compared = 0
+        self.counts = None
+        entry_cache_of(self.app.database).clear()
+
+    def progress(self) -> dict:
+        return {
+            "buckets_left": len(self.buckets),
+            "objects_compared": self.compared,
+        }
+
+    def _finish(self, report: dict) -> None:
+        from ..ledger.entryframe import entry_cache_of
+
+        entry_cache_of(self.app.database).clear()
+        self.bm.last_checkdb = report
+        self.bm._checkdb_run = None
+        if report.get("status") != "ok":
+            log.error("checkdb failed: %s", report)
+        else:
+            log.info("checkdb ok: %s objects", report.get("objects_compared"))
+
+    def step(self) -> None:
+        from ..ledger.entryframe import ledger_key_of, load_entry_by_key
+        from ..xdr.entries import LedgerEntryType
+        from ..xdr.ledger import BucketEntryType, LedgerKey
+
+        if (
+            self.app.ledger_manager.last_closed.header.ledgerSeq
+            != self.start_lcl
+        ):
+            self._finish(
+                {"status": "aborted", "error": "ledger closed during audit"}
+            )
+            return
+        try:
+            if self.buckets:
+                b = self.buckets.pop(0)
+                for e in b:
+                    if e.type == BucketEntryType.LIVEENTRY:
+                        self.state[ledger_key_of(e.value).to_xdr()] = e.value
+                    else:
+                        self.state.pop(e.value.to_xdr(), None)
+                self.app.clock.post(self.step)
+                return
+            if self.items is None:
+                self.items = iter(list(self.state.items()))
+                self.counts = {
+                    LedgerEntryType.ACCOUNT: 0,
+                    LedgerEntryType.TRUSTLINE: 0,
+                    LedgerEntryType.OFFER: 0,
+                }
+            db = self.app.database
+            for _ in range(self.batch):
+                nxt = next(self.items, None)
+                if nxt is None:
+                    table_counts = {
+                        LedgerEntryType.ACCOUNT: db.query_one(
+                            "SELECT COUNT(*) FROM accounts")[0],
+                        LedgerEntryType.TRUSTLINE: db.query_one(
+                            "SELECT COUNT(*) FROM trustlines")[0],
+                        LedgerEntryType.OFFER: db.query_one(
+                            "SELECT COUNT(*) FROM offers")[0],
+                    }
+                    for ty, n in self.counts.items():
+                        if table_counts[ty] != n:
+                            raise RuntimeError(
+                                f"{ty.name} count mismatch: buckets={n} "
+                                f"db={table_counts[ty]}"
+                            )
+                    self._finish({
+                        "status": "ok",
+                        "objects_compared": self.compared,
+                        "accounts": self.counts[LedgerEntryType.ACCOUNT],
+                        "trustlines": self.counts[LedgerEntryType.TRUSTLINE],
+                        "offers": self.counts[LedgerEntryType.OFFER],
+                    })
+                    return
+                key_xdr, entry = nxt
+                key = LedgerKey.from_xdr(key_xdr)
+                self.counts[key.type] += 1
+                frame = load_entry_by_key(key, db)
+                if frame is None:
+                    raise RuntimeError(f"entry missing from DB: {key}")
+                if frame.entry.to_xdr() != entry.to_xdr():
+                    raise RuntimeError(f"entry differs from DB: {key}")
+                self.compared += 1
+            self.app.clock.post(self.step)
+        except Exception as e:
+            self._finish({"status": "error", "error": str(e)})

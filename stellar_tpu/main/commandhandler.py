@@ -44,7 +44,7 @@ class CommandHandler:
             "dropcursor": self.handle_dropcursor,
             "setcursor": self.handle_setcursor,
             "checkpoint": self.handle_checkpoint,
-            "checkdb": lambda q: self.app.bucket_manager.check_db(),
+            "checkdb": self.handle_checkdb,
             "generateload": self.handle_generateload,
             "logrotate": lambda q: {"status": "ok"},
         }
@@ -304,6 +304,15 @@ class CommandHandler:
             q.get("id", ""), int(q.get("cursor", 0))
         )
         return {"status": "ok"}
+
+    def handle_checkdb(self, q: dict) -> dict:
+        """Kick (or poll) the cooperative bucket-vs-DB audit; the scan runs
+        one slice per crank so the reactor keeps serving consensus."""
+        bm = self.app.bucket_manager
+        out = bm.start_check_db_async()
+        if bm.last_checkdb is not None:
+            out["last"] = bm.last_checkdb
+        return out
 
     def handle_checkpoint(self, q: dict) -> dict:
         hm = self.app.history_manager

@@ -1,0 +1,135 @@
+"""Native runtime components (C, loaded via ctypes).
+
+The reference's bucket hot path is native C++ on worker threads
+(src/bucket/Bucket.cpp merge + SHA256, src/main/ApplicationImpl.cpp:120
+worker pool); ours is ``bucketmerge.c``: streaming merge + SHA-256 with no
+Python in the loop.  ctypes releases the GIL for the duration of the call,
+so merges running on the worker pool never stall the main crank — the
+property the reference gets from real C++ threads.
+
+The shared object is built on first use with the system compiler and
+cached next to the source; if no toolchain is available everything falls
+back to the pure-Python implementations in bucket/bucket.py.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "bucketmerge.c")
+_SO = os.path.join(_HERE, "_bucketmerge.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    # per-process temp name: concurrent first-use builds in sibling
+    # processes must not interleave writes into one file
+    tmp = f"{_SO}.{os.getpid()}.tmp"
+    for cc in ("cc", "gcc", "clang"):
+        try:
+            r = subprocess.run(
+                [cc, "-O2", "-shared", "-fPIC", "-o", tmp, _SRC],
+                capture_output=True,
+                timeout=120,
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            continue
+        if r.returncode == 0:
+            os.replace(tmp, _SO)
+            return True
+    try:
+        os.unlink(tmp)
+    except OSError:
+        pass
+    return False
+
+
+def _load():
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(
+            _SRC
+        ):
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        lib.bucket_merge.restype = ctypes.c_int
+        lib.bucket_merge.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.c_char_p,
+            ctypes.c_char * 32,
+            ctypes.POINTER(ctypes.c_longlong),
+        ]
+        lib.sha256_file.restype = ctypes.c_int
+        lib.sha256_file.argtypes = [ctypes.c_char_p, ctypes.c_char * 32]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def merge_files(
+    old_path: str,
+    new_path: str,
+    shadow_paths: Sequence[str],
+    keep_dead: bool,
+    out_path: str,
+) -> Optional[Tuple[bytes, int]]:
+    """Merge two sorted bucket files into out_path.
+
+    Returns (content_hash, record_count), or None if the native engine is
+    unavailable or the merge failed (caller falls back to Python).
+    A zero record count reports hash over the empty stream — the caller
+    maps that to the canonical empty bucket.
+    """
+    lib = _load()
+    if lib is None or len(shadow_paths) > 32:
+        return None
+    shadows = (ctypes.c_char_p * max(1, len(shadow_paths)))()
+    for i, p in enumerate(shadow_paths):
+        shadows[i] = p.encode()
+    out_hash = (ctypes.c_char * 32)()
+    out_count = ctypes.c_longlong(0)
+    rc = lib.bucket_merge(
+        old_path.encode(),
+        new_path.encode(),
+        shadows,
+        len(shadow_paths),
+        1 if keep_dead else 0,
+        out_path.encode(),
+        out_hash,
+        ctypes.byref(out_count),
+    )
+    if rc != 0:
+        return None
+    return bytes(out_hash), int(out_count.value)
+
+
+def sha256_file(path: str) -> Optional[bytes]:
+    lib = _load()
+    if lib is None:
+        return None
+    out = (ctypes.c_char * 32)()
+    if lib.sha256_file(path.encode(), out) != 0:
+        return None
+    return bytes(out)

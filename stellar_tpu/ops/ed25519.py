@@ -329,7 +329,7 @@ class BatchVerifier:
         for start in range(0, len(todo), self.max_batch):
             chunk = todo[start : start + self.max_batch]
             pending.append((chunk, self._dispatch_chunk(chunk)))
-            if len(pending) > PIPELINE_DEPTH:
+            if len(pending) >= PIPELINE_DEPTH:
                 drain_one()
         while pending:
             drain_one()

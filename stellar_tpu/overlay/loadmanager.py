@@ -110,7 +110,11 @@ class LoadManager:
             pid = getattr(p, "peer_id", None)
             if pid is None:
                 continue
-            pc = self.get_peer_costs(bytes(pid.value))
+            # peek only: inserting/promoting here would LRU-evict the very
+            # cost records the scan is ranking
+            pc = self._costs.get(bytes(pid.value))
+            if pc is None:
+                continue
             if worst_costs is None or worst_costs.is_less_than(pc):
                 worst, worst_costs = p, pc
         if worst is not None:

@@ -52,6 +52,39 @@ class TestCheckDB:
         assert report["status"] == "ok"
         assert report["accounts"] >= 4  # root + 3 created
 
+    def test_checkdb_async_matches_sync(self, clock):
+        app = make_app(clock, 46)
+        app.herder.bootstrap()
+        lm = app.ledger_manager
+        target = lm.get_last_closed_ledger_num() + 1
+        assert clock.crank_until(
+            lambda: lm.get_last_closed_ledger_num() >= target, 30
+        )
+        # pause consensus so the audit's LCL snapshot stays stable
+        app.herder.trigger_timer.cancel()
+        bm = app.bucket_manager
+        out = bm.start_check_db_async(batch=1)
+        assert out["status"] == "started"
+        assert clock.crank_until(lambda: bm.last_checkdb is not None, 30)
+        assert bm.last_checkdb["status"] == "ok"
+        assert bm.last_checkdb["objects_compared"] == bm.check_db()[
+            "objects_compared"
+        ]
+
+    def test_checkdb_async_aborts_on_ledger_close(self, clock):
+        app = make_app(clock, 47)
+        app.herder.bootstrap()
+        lm = app.ledger_manager
+        target = lm.get_last_closed_ledger_num() + 1
+        assert clock.crank_until(
+            lambda: lm.get_last_closed_ledger_num() >= target, 30
+        )
+        bm = app.bucket_manager
+        bm.start_check_db_async(batch=1)
+        # keep consensus running: a close should land mid-audit
+        assert clock.crank_until(lambda: bm.last_checkdb is not None, 60)
+        assert bm.last_checkdb["status"] in ("ok", "aborted")
+
     def test_checkdb_detects_tampering(self, clock):
         app = make_app(clock, 42)
         app.herder.bootstrap()
