@@ -52,7 +52,7 @@ PIPELINE_DEPTH = 2  # max in-flight device chunks in BatchVerifier.verify
 
 def point_identity(n, dtype=jnp.int32):
     zero = jnp.zeros((fe.LIMBS, n), dtype)
-    one = zero.at[0].set(1)
+    one = fe.one_fe(n, dtype)
     return (zero, one, one, zero)
 
 
@@ -62,7 +62,7 @@ def point_add(p, q):
     X2, Y2, Z2, T2 = q
     a = fe.mul(fe.sub(Y1, X1), fe.sub(Y2, X2))
     b = fe.mul(fe.add(Y1, X1), fe.add(Y2, X2))
-    c = fe.mul(fe.mul(T1, T2), _D2_FE)
+    c = fe.mul(fe.mul(T1, T2), fe._c("D2", _D2_FE))
     d = fe.mul_small(fe.mul(Z1, Z2), 2)
     e = fe.sub(b, a)
     f = fe.sub(d, c)
@@ -71,8 +71,10 @@ def point_add(p, q):
     return (fe.mul(e, f), fe.mul(g, h), fe.mul(f, g), fe.mul(e, h))
 
 
-def point_add_niels(p, n):
-    """Extended + precomputed niels (YpX, YmX, T2d, Z2): 8M."""
+def point_add_niels(p, n, need_t: bool = True):
+    """Extended + precomputed niels (YpX, YmX, T2d, Z2): 8M (7M w/o T).
+
+    ``need_t=False`` when the result feeds a doubling (which ignores T)."""
     X1, Y1, Z1, T1 = p
     YpX2, YmX2, T2d2, Z22 = n
     a = fe.mul(fe.sub(Y1, X1), YmX2)
@@ -83,11 +85,17 @@ def point_add_niels(p, n):
     f = fe.sub(d, c)
     g = fe.add(d, c)
     h = fe.add(b, a)
-    return (fe.mul(e, f), fe.mul(g, h), fe.mul(f, g), fe.mul(e, h))
+    t = fe.mul(e, h) if need_t else jnp.zeros_like(X1)
+    return (fe.mul(e, f), fe.mul(g, h), fe.mul(f, g), t)
 
 
-def point_double(p):
-    """dbl-2008-hwcd with a=-1: 4S + 4M."""
+def point_double(p, need_t: bool = True):
+    """dbl-2008-hwcd with a=-1: 4S + 4M (3M with ``need_t=False``).
+
+    Doubling never reads the input T, so inside a doubling chain only the
+    last double before an addition needs to produce T — the others skip
+    the E·H multiply and return a zero T placeholder.
+    """
     X1, Y1, Z1, _ = p
     a = fe.sqr(X1)
     b = fe.sqr(Y1)
@@ -97,7 +105,8 @@ def point_double(p):
     g = fe.add(d, b)
     f = fe.sub(g, c)
     h = fe.sub(d, b)
-    return (fe.mul(e, f), fe.mul(g, h), fe.mul(f, g), fe.mul(e, h))
+    t = fe.mul(e, h) if need_t else jnp.zeros_like(X1)
+    return (fe.mul(e, f), fe.mul(g, h), fe.mul(f, g), t)
 
 
 def to_niels(p):
@@ -105,7 +114,7 @@ def to_niels(p):
     return (
         fe.add(Y, X),
         fe.sub(Y, X),
-        fe.mul(T, _D2_FE),
+        fe.mul(T, fe._c("D2", _D2_FE)),
         fe.mul_small(Z, 2),
     )
 
@@ -123,23 +132,23 @@ def compress(p):
     y = fe.mul(Y, zinv)
     by = fe.bytes_from_limbs(fe.canonical(y))
     sign = fe.parity(x)
-    by = by.at[31].add(sign << 7)
+    by = fe.set_row(by, 31, by[31] + (sign << 7))
     return by
 
 
 def decompress(y_limbs, sign):
     """-> (point, fail) matching ref25519.decompress for canonical y."""
-    one = jnp.zeros_like(y_limbs).at[0].set(1)
+    one = fe.one_fe(y_limbs.shape[1:], y_limbs.dtype)
     yy = fe.sqr(y_limbs)
     u = fe.sub(yy, one)
-    v = fe.add(fe.mul(yy, _D_FE), one)
+    v = fe.add(fe.mul(yy, fe._c("D", _D_FE)), one)
     v3 = fe.mul(fe.sqr(v), v)
     v7 = fe.mul(fe.sqr(v3), v)
     x = fe.mul(fe.mul(u, v3), fe.pow_p58(fe.mul(u, v7)))
     vxx = fe.mul(v, fe.sqr(x))
     ok1 = fe.eq(vxx, u)
     ok2 = fe.eq(vxx, fe.neg(u))
-    x = fe.select(ok2, fe.mul(x, _SQRT_M1_FE), x)
+    x = fe.select(ok2, fe.mul(x, fe._c("SQRT_M1", _SQRT_M1_FE)), x)
     fail = ~(ok1 | ok2)
     fail = fail | (fe.is_zero(x) & (sign == 1))
     flip = fe.parity(x) != sign
@@ -228,7 +237,7 @@ def verify_kernel(a_bytes, r_bytes, s_nibs, h_nibs):
     returns   (N,) bool
     """
     a_sign = a_bytes[31] >> 7
-    a_masked = a_bytes.at[31].set(a_bytes[31] & 0x7F)
+    a_masked = fe.set_row(a_bytes, 31, a_bytes[31] & 0x7F)
     a_y_limbs = fe.limbs_from_bytes(a_masked)
     a_pt, fail = decompress(a_y_limbs, a_sign)
     neg_a = point_negate(a_pt)
@@ -238,10 +247,13 @@ def verify_kernel(a_bytes, r_bytes, s_nibs, h_nibs):
 
     def body(i, acc):
         t = WINDOWS - 1 - i
-        for _ in range(4):
-            acc = point_double(acc)
+        for k in range(4):
+            # only the last double feeds an addition, which is the sole
+            # consumer of T — the first three skip the E·H multiply
+            acc = point_double(acc, need_t=(k == 3))
         acc = point_add_niels(acc, _select_base(s_nibs[t]))
-        acc = point_add_niels(acc, _select_dyn(a_table, h_nibs[t]))
+        # the next consumer is the following window's doubling: no T needed
+        acc = point_add_niels(acc, _select_dyn(a_table, h_nibs[t]), need_t=False)
         return acc
 
     acc = jax.lax.fori_loop(0, WINDOWS, body, point_identity(n))
@@ -267,12 +279,36 @@ def _nibbles_np(scalars_le_bytes: np.ndarray) -> np.ndarray:
 
 class BatchVerifier:
     """Pads batches to pow-2 buckets (one XLA compile per bucket), runs the
-    kernel, scatters results; host gate failures never reach the device."""
+    kernel, scatters results; host gate failures never reach the device.
 
-    def __init__(self, max_batch: int = 4096, mesh=None, min_device_batch: int = 16):
+    ``backend="auto"`` picks the Pallas kernel (ops/ed25519_pallas.py —
+    measured 4× the XLA lowering on v5e, PROFILE.md) on a real accelerator
+    and the plain XLA kernel on CPU or when a mesh shards the batch axis
+    (pallas_call isn't jit-shardable over the mesh; the XLA kernel is)."""
+
+    def __init__(
+        self,
+        max_batch: int = 4096,
+        mesh=None,
+        min_device_batch: int = 16,
+        backend: str = "auto",
+    ):
         self.max_batch = max_batch
         self.min_device_batch = min_device_batch
         self.mesh = mesh
+        if backend == "auto":
+            # pallas is a TPU (Mosaic) lowering: not CPU, and not GPU either
+            backend = (
+                "pallas"
+                if mesh is None and jax.default_backend() == "tpu"
+                else "xla"
+            )
+        self.backend = backend
+        if self.backend == "pallas":
+            from .ed25519_pallas import NT
+
+            # every device batch must be a whole number of pallas tiles
+            self.max_batch = max(NT, (self.max_batch + NT - 1) // NT * NT)
         self._kernel = self._make_kernel()
         self.n_device_calls = 0
         self.n_items = 0
@@ -280,23 +316,29 @@ class BatchVerifier:
         self.verify_seconds = 0.0
 
     def _make_kernel(self):
-        kern = verify_kernel
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as PSpec
 
             batch_axis = self.mesh.axis_names[0]
             shard = NamedSharding(self.mesh, PSpec(None, batch_axis))
             vec = NamedSharding(self.mesh, PSpec(batch_axis))
-            kern = jax.jit(
+            return jax.jit(
                 verify_kernel,
                 in_shardings=(shard, shard, shard, shard),
                 out_shardings=vec,
             )
-            return kern
-        return jax.jit(kern)
+        if self.backend == "pallas":
+            from .ed25519_pallas import verify_kernel_pallas
+
+            return verify_kernel_pallas
+        return jax.jit(verify_kernel)
 
     def _bucket(self, n: int) -> int:
         b = self.min_device_batch
+        if self.backend == "pallas":
+            from .ed25519_pallas import NT
+
+            b = max(b, NT)  # pallas grid tiles the batch in NT lanes
         while b < n:
             b *= 2
         if self.mesh is not None:
@@ -307,16 +349,31 @@ class BatchVerifier:
         """items: (pubkey32, msg, sig64) triples -> list of bool."""
         out = [False] * len(items)
         todo = []  # (orig_idx, pk, msg, sig)
+        wellformed = []
         for i, (pk, msg, sig) in enumerate(items):
-            if ref.strict_input_ok(pk, sig):
-                todo.append((i, pk, msg, sig))
+            if len(pk) == 32 and len(sig) == 64:
+                wellformed.append((i, pk, msg, sig))
             else:
                 self.n_gate_rejects += 1
+        if wellformed:
+            pk_arr = np.frombuffer(
+                b"".join(w[1] for w in wellformed), dtype=np.uint8
+            ).reshape(-1, 32)
+            sig_arr = np.frombuffer(
+                b"".join(w[3] for w in wellformed), dtype=np.uint8
+            ).reshape(-1, 64)
+            gate = ref.strict_input_ok_batch(pk_arr, sig_arr)
+            for ok, w in zip(gate, wellformed):
+                if ok:
+                    todo.append(w)
+                else:
+                    self.n_gate_rejects += 1
         self.n_items += len(items)
-        # pipeline with bounded depth: staging of chunk k+1 overlaps device
-        # compute of chunk k, but at most PIPELINE_DEPTH chunks of device
-        # buffers are ever in flight (unbounded dispatch could OOM the chip
-        # on huge replays)
+        # Pipelined with bounded depth: a stager thread stages AND
+        # dispatches chunk k+1 (numpy/hashlib prep is GIL-releasing C work)
+        # while the main thread blocks draining chunk k-1 from the device;
+        # at most PIPELINE_DEPTH chunks of device buffers are ever in
+        # flight (unbounded dispatch could OOM the chip on huge replays).
         pending = []
         t0 = time.perf_counter()
 
@@ -326,22 +383,59 @@ class BatchVerifier:
             for (i, *_), ok in zip(chunk, results):
                 out[i] = bool(ok)
 
-        for start in range(0, len(todo), self.max_batch):
-            chunk = todo[start : start + self.max_batch]
-            pending.append((chunk, self._dispatch_chunk(chunk)))
-            if len(pending) >= PIPELINE_DEPTH:
+        chunks = [
+            todo[s : s + self.max_batch]
+            for s in range(0, len(todo), self.max_batch)
+        ]
+        if len(chunks) <= 1:
+            for chunk in chunks:
+                pending.append((chunk, self._dispatch_chunk(chunk)))
+            while pending:
                 drain_one()
-        while pending:
-            drain_one()
+        else:
+            import threading
+            from concurrent.futures import ThreadPoolExecutor
+
+            sem = threading.Semaphore(PIPELINE_DEPTH)
+
+            def stage_and_dispatch(c):
+                staged = self._stage_chunk(c)  # host prep runs ahead freely
+                sem.acquire()  # bound un-drained device buffers in flight
+                return self._dispatch_staged(staged)
+
+            with ThreadPoolExecutor(max_workers=1) as stager:
+                futs = [
+                    (c, stager.submit(stage_and_dispatch, c)) for c in chunks
+                ]
+                try:
+                    for chunk, f in futs:
+                        pending.append((chunk, f.result()))
+                        if len(pending) >= PIPELINE_DEPTH:
+                            drain_one()
+                            sem.release()
+                    while pending:
+                        drain_one()
+                        sem.release()
+                except BaseException:
+                    # unblock the stager (it may sit in sem.acquire with no
+                    # further releases coming) and drop queued work, or the
+                    # executor __exit__ would deadlock instead of raising
+                    for _, f in futs:
+                        f.cancel()
+                    for _ in range(len(chunks)):
+                        sem.release()
+                    raise
         # wall time of the whole batched call: staging + hashing + device
         # compute + sync (NOT device-only — see stats())
         self.verify_seconds += time.perf_counter() - t0
         return out
 
-    def _dispatch_chunk(self, chunk):
+    def _stage_chunk(self, chunk):
+        """Host-side prep: bucket-padded byte columns + SHA-512 mod L.
+        Pure numpy/hashlib (GIL-releasing C) — safe on the stager thread."""
         n = len(chunk)
         if n == 0:
-            return np.zeros(0, dtype=bool)
+            return None
         bucket = self._bucket(n)
         a_bytes = np.zeros((bucket, 32), dtype=np.uint8)
         r_bytes = np.zeros((bucket, 32), dtype=np.uint8)
@@ -360,14 +454,35 @@ class BatchVerifier:
         for j, (_, pk, msg, sig) in enumerate(chunk):
             h = int.from_bytes(sha(sig[:32] + pk + msg).digest(), "little") % L
             h_bytes[j] = np.frombuffer(h.to_bytes(32, "little"), dtype=np.uint8)
-        ok = self._kernel(
-            jnp.asarray(np.ascontiguousarray(a_bytes.T).astype(np.int32)),
-            jnp.asarray(np.ascontiguousarray(r_bytes.T).astype(np.int32)),
-            jnp.asarray(_nibbles_np(s_bytes)),
-            jnp.asarray(_nibbles_np(h_bytes)),
-        )
+        return (a_bytes, r_bytes, s_bytes, h_bytes)
+
+    def _dispatch_staged(self, staged):
+        """Upload staged byte columns and launch the kernel.  Runs on the
+        stager thread in the multi-chunk pipeline, on the caller's thread
+        for single-chunk batches."""
+        if staged is None:
+            return np.zeros(0, dtype=bool)
+        a_bytes, r_bytes, s_bytes, h_bytes = staged
+        if self.backend == "pallas":
+            # raw uint8 byte columns; nibble split happens on device
+            ok = self._kernel(
+                jnp.asarray(np.ascontiguousarray(a_bytes.T)),
+                jnp.asarray(np.ascontiguousarray(r_bytes.T)),
+                jnp.asarray(np.ascontiguousarray(s_bytes.T)),
+                jnp.asarray(np.ascontiguousarray(h_bytes.T)),
+            )
+        else:
+            ok = self._kernel(
+                jnp.asarray(np.ascontiguousarray(a_bytes.T).astype(np.int32)),
+                jnp.asarray(np.ascontiguousarray(r_bytes.T).astype(np.int32)),
+                jnp.asarray(_nibbles_np(s_bytes)),
+                jnp.asarray(_nibbles_np(h_bytes)),
+            )
         self.n_device_calls += 1
         return ok
+
+    def _dispatch_chunk(self, chunk):
+        return self._dispatch_staged(self._stage_chunk(chunk))
 
     def stats(self) -> dict:
         return {

@@ -72,10 +72,62 @@ def _sub_pad_limbs() -> np.ndarray:
 
 
 SUB_PAD = jnp.asarray(_sub_pad_limbs()).reshape(LIMBS, 1)
+P_LIMBS_COL = jnp.asarray(int_to_limbs(P)).reshape(LIMBS, 1)
+
+# Pallas kernels may not close over array constants — they must arrive as
+# kernel inputs.  ops/ed25519_pallas.py passes a packed constant block and
+# installs these overrides for the duration of the kernel trace.  A
+# ContextVar (not a module global) keeps a trace on one thread — e.g. the
+# BatchVerifier stager thread — from leaking its tracer constants into a
+# concurrent trace on another thread.
+import contextvars
+
+_OVERRIDE: contextvars.ContextVar = contextvars.ContextVar(
+    "fe_const_override", default={}
+)
+
+
+class const_override:
+    """Context manager substituting the module's array constants during a
+    pallas kernel trace (keys: SUB_PAD, P_COL, D, D2, SQRT_M1, PALLAS)."""
+
+    def __init__(self, d):
+        self.d = d
+
+    def __enter__(self):
+        self._token = _OVERRIDE.set(self.d)
+
+    def __exit__(self, *exc):
+        _OVERRIDE.reset(self._token)
+
+
+def _c(name, default):
+    return _OVERRIDE.get().get(name, default)
 
 
 def zero_like(x):
     return jnp.zeros_like(x)
+
+
+def set_row(x, i: int, v):
+    """x with row i replaced by v (static i), via concatenation — the
+    jnp ``.at[i].set`` form lowers to lax.scatter, which Pallas/Mosaic
+    cannot compile."""
+    parts = []
+    if i > 0:
+        parts.append(x[:i])
+    parts.append(v[None] if v.ndim == x.ndim - 1 else v)
+    if i < x.shape[0] - 1:
+        parts.append(x[i + 1 :])
+    return jnp.concatenate(parts, axis=0)
+
+
+def one_fe(n, dtype=jnp.int32):
+    """(20, *n) field element 1 without scatter ops."""
+    shape = n if isinstance(n, tuple) else (n,)
+    one = jnp.ones((1,) + shape, dtype)
+    rest = jnp.zeros((LIMBS - 1,) + shape, dtype)
+    return jnp.concatenate([one, rest], axis=0)
 
 
 def _carry_pass(x: jnp.ndarray) -> jnp.ndarray:
@@ -87,10 +139,11 @@ def _carry_pass(x: jnp.ndarray) -> jnp.ndarray:
     overflow back to limb 0 via 2^255 ≡ 19 (mod p).  Arithmetic shifts
     floor-divide, so negative limbs borrow correctly.
     """
-    c_lo = x[:-1] >> RADIX
-    r_lo = x[:-1] - (c_lo << RADIX)
-    c_hi = x[-1] >> 8
-    r_hi = x[-1] - (c_hi << 8)
+    k = x.shape[0] - 1  # positive static indices: negative indexing
+    c_lo = x[:k] >> RADIX  # lowers to dynamic_slice, which Mosaic lacks
+    r_lo = x[:k] - (c_lo << RADIX)
+    c_hi = x[k] >> 8
+    r_hi = x[k] - (c_hi << 8)
     carries = jnp.concatenate([(c_hi * 19)[None], c_lo], axis=0)
     return jnp.concatenate([r_lo, r_hi[None]], axis=0) + carries
 
@@ -127,7 +180,12 @@ def carry_exact(x: jnp.ndarray) -> jnp.ndarray:
 
 
 def _bcast(c, x):
-    """Reshape a (20, 1) constant to broadcast against x's trailing dims."""
+    """Reshape a (20, 1) constant to broadcast against x's trailing dims.
+    Pallas overrides pass constants already expanded to x's full shape
+    (Mosaic cannot broadcast in sublanes and lanes at once) — pass through.
+    """
+    if c.shape == x.shape:
+        return c
     return c.reshape((LIMBS,) + (1,) * (x.ndim - 1))
 
 
@@ -139,11 +197,11 @@ def add(a, b):
 def sub(a, b):
     # a - b + pad: pad has every limb >= 2^13+ε, so limbs stay positive in
     # [~8150, 3*2^13] — no borrow ripple, 2 passes suffice
-    return carry(a - b + _bcast(SUB_PAD, a), passes=2)
+    return carry(a - b + _bcast(_c("SUB_PAD", SUB_PAD), a), passes=2)
 
 
 def neg(a):
-    return carry(_bcast(SUB_PAD, a) - a, passes=2)
+    return carry(_bcast(_c("SUB_PAD", SUB_PAD), a) - a, passes=2)
 
 
 def mul(a, b):
@@ -159,20 +217,60 @@ def mul(a, b):
     multiplies can't overflow either.
     """
     n = a.shape[1:]
-    prod = jnp.zeros((2 * LIMBS - 1,) + n, dtype=jnp.int32)
-    for j in range(LIMBS):
-        prod = prod.at[j : j + LIMBS].add(a * b[j][None])
+    if _c("PALLAS", False):
+        # Mosaic can lower neither lax.scatter (.at[].add) nor
+        # lax.dynamic_slice on values — accumulate the low (cols 0..19)
+        # and high (cols 20..38) halves with static slices + concats.
+        lo = jnp.zeros((LIMBS,) + n, dtype=jnp.int32)
+        hi = jnp.zeros((LIMBS - 1,) + n, dtype=jnp.int32)
+        for j in range(LIMBS):
+            term = a * b[j][None]  # contributes to columns j .. j+19
+            if j == 0:
+                lo = lo + term
+            else:
+                lo = lo + jnp.concatenate(
+                    [jnp.zeros((j,) + n, jnp.int32), term[: LIMBS - j]], 0
+                )
+                hi_parts = [term[LIMBS - j :]]
+                if LIMBS - 1 - j > 0:
+                    hi_parts.append(
+                        jnp.zeros((LIMBS - 1 - j,) + n, jnp.int32)
+                    )
+                hi = hi + (
+                    jnp.concatenate(hi_parts, 0)
+                    if len(hi_parts) > 1
+                    else hi_parts[0]
+                )
+        prod = jnp.concatenate([lo, hi], axis=0)
+    else:
+        prod = jnp.zeros((2 * LIMBS - 1,) + n, dtype=jnp.int32)
+        for j in range(LIMBS):
+            prod = prod.at[j : j + LIMBS].add(a * b[j][None])
+    return _fold_and_carry(prod, n)
+
+
+def _fold_and_carry(prod, n):
+    """(39, ...) product columns -> weakly-reduced (20, ...) element.
+
+    Shared tail of mul/sqr: fold the 19 high limbs back with
+    2^260 ≡ 608 (mod p), split so no int32 overflow (see mul), then 3
+    parallel carry passes.
+    """
     lo = prod[:LIMBS]
     hi = prod[LIMBS:]  # 19 limbs, each < 2^31
     hi_lo = hi & MASK
     hi_hi = hi >> RADIX
     zero = jnp.zeros((1,) + n, dtype=jnp.int32)
-    lo = lo.at[: LIMBS - 1].add(hi_lo * FOLD)
+    lo = lo + jnp.concatenate([hi_lo * FOLD, zero], axis=0)
     lo = lo + jnp.concatenate([zero, hi_hi * FOLD], axis=0)
     return carry(lo, passes=3)
 
 
 def sqr(a):
+    """Squaring = mul(a, a).  A half-product triangular variant was
+    measured SLOWER on TPU: variable-length slice updates and the strided
+    diagonal scatter defeat XLA's fusion, costing more than the saved
+    multiplies.  The uniform schoolbook wins."""
     return mul(a, a)
 
 
@@ -234,7 +332,7 @@ def canonical(x):
         gt = gt | (eq_so_far & (x[i] > pi))
         eq_so_far = eq_so_far & (x[i] == pi)
     need_sub = gt | eq_so_far
-    sub_p = _bcast(jnp.asarray(int_to_limbs(P)).reshape(LIMBS, 1), x)
+    sub_p = _bcast(_c("P_COL", P_LIMBS_COL), x)
     return carry_exact(x - jnp.where(need_sub[None], sub_p, 0))
 
 

@@ -213,6 +213,93 @@ class TestBatchVerifier:
         assert bv.n_device_calls == calls_before
 
 
+class TestPallasKernel:
+    """The Pallas lowering (ops/ed25519_pallas.py) must agree bit-for-bit
+    with the XLA verify_kernel — run in interpreter mode on CPU over one
+    full tile of mixed valid/corrupt/undecompressable inputs."""
+
+    def test_pallas_matches_xla_kernel(self):
+        import hashlib
+
+        from stellar_tpu.ops.ed25519_pallas import NT, verify_kernel_pallas
+        from stellar_tpu.ops.ref25519 import L
+
+        rng = random.Random(42)
+        a_b = np.zeros((NT, 32), np.uint8)
+        r_b = np.zeros((NT, 32), np.uint8)
+        s_b = np.zeros((NT, 32), np.uint8)
+        h_b = np.zeros((NT, 32), np.uint8)
+        for i in range(NT):
+            sk = SecretKey.pseudo_random_for_testing(i)
+            msg = b"pallas %d" % i
+            sig = bytearray(sk.sign(msg))
+            pk = bytearray(sk.public_raw)
+            if i % 3 == 1:  # corrupt signature
+                sig[rng.randrange(64)] ^= 1 << rng.randrange(8)
+            if i % 7 == 3:  # undecompressable / wrong A
+                pk[rng.randrange(31)] ^= 1 << rng.randrange(8)
+            sig, pk = bytes(sig), bytes(pk)
+            h = (
+                int.from_bytes(
+                    hashlib.sha512(sig[:32] + pk + msg).digest(), "little"
+                )
+                % L
+            )
+            a_b[i] = np.frombuffer(pk, np.uint8)
+            r_b[i] = np.frombuffer(sig[:32], np.uint8)
+            s_b[i] = np.frombuffer(sig[32:], np.uint8)
+            h_b[i] = np.frombuffer(h.to_bytes(32, "little"), np.uint8)
+        xla_args = (
+            jnp.asarray(np.ascontiguousarray(a_b.T).astype(np.int32)),
+            jnp.asarray(np.ascontiguousarray(r_b.T).astype(np.int32)),
+            jnp.asarray(ed._nibbles_np(s_b)),
+            jnp.asarray(ed._nibbles_np(h_b)),
+        )
+        pallas_args = tuple(
+            jnp.asarray(np.ascontiguousarray(x.T))
+            for x in (a_b, r_b, s_b, h_b)
+        )
+        want = np.asarray(jax.jit(ed.verify_kernel)(*xla_args))
+        got = np.asarray(verify_kernel_pallas(*pallas_args, interpret=True))
+        assert want.sum() > 0 and (~want).sum() > 0  # both classes present
+        assert (want == got).all()
+
+    def test_batch_gate_matches_scalar_gate(self):
+        """strict_input_ok_batch must accept exactly what strict_input_ok
+        accepts — valid sigs, s >= L, small-order R/A, non-canonical A."""
+        from stellar_tpu.ops import ref25519 as ref
+
+        rng = random.Random(5)
+        pks, sigs = [], []
+        sk = SecretKey.pseudo_random_for_testing(1)
+        good_sig = sk.sign(b"x")
+        for e in ref.small_order_blacklist():
+            pks.append(e)
+            sigs.append(good_sig)
+            pks.append(sk.public_raw)
+            sigs.append(e + good_sig[32:])
+        bad_s = (int.from_bytes(good_sig[32:], "little") + ref.L).to_bytes(
+            32, "little"
+        )
+        pks.append(sk.public_raw)
+        sigs.append(good_sig[:32] + bad_s)
+        pks.append((2**255 - 5).to_bytes(32, "little"))
+        sigs.append(good_sig)
+        for i in range(64):
+            k = SecretKey.pseudo_random_for_testing(100 + i)
+            sg = bytearray(k.sign(b"m%d" % i))
+            if i % 2:
+                sg[rng.randrange(64)] ^= 1 << rng.randrange(8)
+            pks.append(k.public_raw)
+            sigs.append(bytes(sg))
+        want = [ref.strict_input_ok(p, s) for p, s in zip(pks, sigs)]
+        got = ref.strict_input_ok_batch(
+            np.frombuffer(b"".join(pks), np.uint8).reshape(-1, 32),
+            np.frombuffer(b"".join(sigs), np.uint8).reshape(-1, 64),
+        )
+        assert got.tolist() == want
+
+
 class TestShardedVerifier:
     """End-to-end make_sharded_verifier over the 8-device CPU mesh that
     conftest.py sets up — the multi-chip data-parallel path the driver's
