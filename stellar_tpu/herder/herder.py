@@ -7,10 +7,11 @@ XDR-encoded ``StellarValue{txSetHash, closeTime, upgrades}``.  Owns the
 tracking/not-tracking consensus state machine (herder/readme.md).
 
 Batch-verify note (the TPU angle): inbound SCP envelope signatures all
-funnel through ``verify_envelope`` → the shared verify cache; floods of
-envelopes arriving through the overlay are pre-warmed in one SigBackend
-batch by ``Peer.recv_scp_batch`` before being fed here one by one, so the
-eager check is a cache hit (same pattern as TxSetFrame.check_valid).
+funnel through ``verify_envelope`` → the shared verify cache; envelopes
+arriving through the overlay are coalesced per crank and verified in one
+SigBackend batch by ``OverlayManager._flush_scp_batch`` before being fed
+here one by one, so the eager check is a cache hit (same pattern as
+TxSetFrame.check_valid).
 """
 
 from __future__ import annotations

@@ -41,6 +41,15 @@ class OverlayManager:
         from .loadmanager import LoadManager
 
         self.load_manager = LoadManager(app)
+        # per-crank SCP envelope coalescing (enqueue_scp_envelope)
+        self._scp_batch: List = []
+        self._scp_flush_posted = False
+        self.m_scp_batch_flush = app.metrics.new_meter(
+            ("overlay", "scp-batch", "flush"), "batch"
+        )
+        self.m_scp_batch_size = app.metrics.new_counter(
+            ("overlay", "scp-batch", "envelopes")
+        )
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
@@ -163,6 +172,35 @@ class OverlayManager:
         return len(self.authenticated_peers())
 
     # -- flooding -----------------------------------------------------------
+    def enqueue_scp_envelope(self, envelope) -> None:
+        """Coalesce every SCP envelope received during the current crank
+        into ONE SigBackend batch, then hand them to the herder.
+
+        The reference verifies eagerly inside Herder::recvSCPEnvelope
+        (/root/reference/src/herder/HerderImpl.cpp:347-364); on the TPU
+        backend an eager per-envelope check would be one device dispatch
+        per message.  Instead the flush — posted once per crank — verifies
+        all queued envelopes in a single batch, warming the shared verify
+        cache so the herder's eager checks are cache hits with identical
+        accept/reject results."""
+        self._scp_batch.append(envelope)
+        if not self._scp_flush_posted:
+            self._scp_flush_posted = True
+            self.app.clock.post(self._flush_scp_batch)
+
+    def _flush_scp_batch(self) -> None:
+        batch, self._scp_batch = self._scp_batch, []
+        self._scp_flush_posted = False
+        if self._shutting_down or not batch:
+            return
+        herder = self.app.herder
+        triples = [herder.envelope_verify_triple(env) for env in batch]
+        self.app.sig_backend.verify_batch(triples)
+        self.m_scp_batch_flush.mark()
+        self.m_scp_batch_size.inc(len(batch))
+        for env in batch:
+            herder.recv_scp_envelope(env)
+
     def recv_flooded_msg(self, msg: StellarMessage, peer: Peer) -> bool:
         """Record a flooded message arrival; False if already seen."""
         return self.floodgate.add_record(msg, peer)

@@ -403,14 +403,9 @@ class Peer:
         if not om.recv_flooded_msg(msg, self):
             return  # already seen
         envelope: SCPEnvelope = msg.value
-        # TPU pre-warm: run the ed25519 check through the batch backend so
-        # the Herder's eager verify is a cache hit (SURVEY §7 flush points)
-        try:
-            triple = self.app.herder.envelope_verify_triple(envelope)
-            self.app.sig_backend.verify_batch([triple])
-        except Exception:
-            pass
-        self.app.herder.recv_scp_envelope(envelope)
+        # all envelopes that arrive this crank verify as ONE SigBackend
+        # batch before reaching the herder (OverlayManager flush)
+        om.enqueue_scp_envelope(envelope)
 
     def recv_get_scp_state(self, msg: StellarMessage) -> None:
         self.app.herder.send_scp_state_to_peer(msg.value, self)

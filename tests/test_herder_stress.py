@@ -169,6 +169,45 @@ def test_garbled_envelope_bytes_dont_crash_peer_path(clock):
     assert bad > 150  # nearly all random blobs must be rejected
 
 
+def test_scp_envelopes_coalesce_into_one_sig_batch(clock):
+    """Envelopes received within one crank verify as ONE SigBackend batch
+    (OverlayManager._flush_scp_batch), not one call per envelope — the
+    BASELINE.json 'SCP nomination/ballot envelope signatures' config."""
+    cfg = T.get_test_config(74)
+    cfg.MANUAL_CLOSE = False
+    app = Application.create(clock, cfg, new_db=True)
+    app.herder.bootstrap()
+    lm = app.ledger_manager
+    h = app.herder
+    rng = random.Random(21)
+    assert clock.crank_until(lambda: lm.get_last_closed_ledger_num() >= 2, 30)
+
+    calls = []
+    inner_verify = app.sig_backend.verify_batch
+
+    def counting_verify(triples):
+        calls.append(len(triples))
+        return inner_verify(triples)
+
+    app.sig_backend.verify_batch = counting_verify
+    before_valid = h.m_envelope_validsig.count
+    om = app.overlay_manager
+    n = 40
+    for i in range(n):
+        signer = SecretKey.pseudo_random_for_testing(5000 + i)
+        env = forged_envelope(app, rng, h.next_consensus_ledger_index(), signer)
+        sign_envelope_as(h, env, signer)
+        om.enqueue_scp_envelope(env)  # same-crank arrivals
+    assert calls == []  # nothing verified until the posted flush runs
+    clock.crank(block=False)
+    app.sig_backend.verify_batch = inner_verify
+    # one coalesced batch carried all n envelopes...
+    assert calls and calls[0] == n
+    # ...and the herder's eager per-envelope checks all hit the warm cache
+    assert h.m_envelope_validsig.count - before_valid == n
+    app.graceful_stop()
+
+
 def test_sustained_envelope_stress_with_batch_verify(clock):
     """1000 foreign envelopes pre-verified through the SigBackend batch
     path (the overlay's recv_scp_batch pattern), then fed to the herder —
