@@ -90,6 +90,35 @@ class BucketManager:
     def add_batch(self, ledger_seq: int, live_entries, dead_entries) -> None:
         self.bucket_list.add_batch(self.app, ledger_seq, live_entries, dead_entries)
 
+    # ledger-header snapshot hooks (reference BucketManagerImpl.cpp:300-332)
+    SKIP_1 = 50
+    SKIP_2 = 5000
+    SKIP_3 = 50000
+    SKIP_4 = 500000
+
+    def snapshot_ledger(self, header) -> None:
+        """Write bucketListHash + rotate the header skipList
+        (reference: BucketManagerImpl::snapshotLedger, .cpp:300-306)."""
+        header.bucketListHash = self.get_hash()
+        self.calculate_skip_values(header)
+
+    def calculate_skip_values(self, header) -> None:
+        """skipList rotation at SKIP_1/2/3/4 boundaries (reference:
+        BucketManagerImpl::calculateSkipValues, .cpp:308-331; behavior
+        pinned by BucketTests.cpp:100-176)."""
+        if header.ledgerSeq % self.SKIP_1 != 0:
+            return
+        v = header.ledgerSeq - self.SKIP_1
+        if v > 0 and v % self.SKIP_2 == 0:
+            v = header.ledgerSeq - self.SKIP_2 - self.SKIP_1
+            if v > 0 and v % self.SKIP_3 == 0:
+                v = header.ledgerSeq - self.SKIP_3 - self.SKIP_2 - self.SKIP_1
+                if v > 0 and v % self.SKIP_4 == 0:
+                    header.skipList[3] = header.skipList[2]
+                header.skipList[2] = header.skipList[1]
+            header.skipList[1] = header.skipList[0]
+        header.skipList[0] = header.bucketListHash
+
     def get_hash(self) -> bytes:
         return self.bucket_list.get_hash()
 
@@ -251,6 +280,7 @@ class _CheckDBRun:
             for b in (lev.snap, lev.curr)
         ]
         self.state: Dict[bytes, object] = {}
+        self._replay_iter = None  # held iterator into the current bucket
         self.items = None  # iterator over final state, set after replay
         self.compared = 0
         self.counts = None
@@ -287,15 +317,30 @@ class _CheckDBRun:
             )
             return
         try:
-            if self.buckets:
-                b = self.buckets.pop(0)
-                for e in b:
+            if self.buckets or self._replay_iter is not None:
+                # bounded replay: the deepest bucket holds most of the
+                # entries, so one-whole-bucket-per-crank would block the
+                # reactor nearly as long as a synchronous scan — hold an
+                # iterator into the current bucket and replay at most
+                # 10*batch entries per crank
+                budget = self.batch * 10
+                while budget > 0:
+                    if self._replay_iter is None:
+                        if not self.buckets:
+                            break
+                        self._replay_iter = iter(self.buckets.pop(0))
+                    e = next(self._replay_iter, None)
+                    if e is None:
+                        self._replay_iter = None
+                        continue
                     if e.type == BucketEntryType.LIVEENTRY:
                         self.state[ledger_key_of(e.value).to_xdr()] = e.value
                     else:
                         self.state.pop(e.value.to_xdr(), None)
-                self.app.clock.post(self.step)
-                return
+                    budget -= 1
+                if self.buckets or self._replay_iter is not None:
+                    self.app.clock.post(self.step)
+                    return
             if self.items is None:
                 self.items = iter(list(self.state.items()))
                 self.counts = {

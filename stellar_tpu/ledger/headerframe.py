@@ -1,8 +1,9 @@
 """LedgerHeaderFrame: ledgerheaders table (reference: src/ledger/LedgerHeaderFrame.*).
 
-Header hash = SHA256(xdr(header)).  Note: in this protocol snapshot the
-skipList field exists on the wire but is never maintained (no reference code
-writes it) — it stays zeroed, and we preserve that behavior for hash parity.
+Header hash = SHA256(xdr(header)).  The skipList is maintained by the
+bucket manager at close: BucketManager.calculate_skip_values rotates
+skipList[0..3] at SKIP_1/2/3/4 ledger boundaries, mirroring the reference
+(src/bucket/BucketManagerImpl.cpp:308-331) for header-hash parity.
 """
 
 from __future__ import annotations

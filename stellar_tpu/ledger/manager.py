@@ -331,7 +331,8 @@ class LedgerManager:
             delta.get_live_entries(),
             delta.get_dead_entries(),
         )
-        self.current.header.bucketListHash = self.app.bucket_manager.get_hash()
+        # bucketListHash + skipList rotation (BucketManagerImpl.cpp:300-331)
+        self.app.bucket_manager.snapshot_ledger(self.current.header)
         self.current.invalidate_hash()
         self.current.store_insert(self.database)
         ps = PersistentState(self.database)

@@ -48,7 +48,7 @@ def gen_fuzz(filename: str, n: int = 3, seed: int = None) -> None:
 
 
 def _try_read(stream: XDRInputFileStream):
-    """Next message, substituting HELLO for undecodable records."""
+    """Next message, substituting GET_PEERS for undecodable records."""
     try:
         return stream.read_one(StellarMessage)
     except XdrError as e:

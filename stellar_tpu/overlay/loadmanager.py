@@ -98,6 +98,10 @@ class LoadManager:
         below MINIMUM_IDLE_PERCENT (LoadManager::maybeShedExcessLoad)."""
         min_idle = self.app.config.MINIMUM_IDLE_PERCENT
         if min_idle <= 0:
+            # keep the accounting window fresh while shedding is disabled,
+            # or a later enable (via /ll or config reload) would judge idle
+            # time over the entire process uptime and shed spuriously
+            self._reset_window()
             return
         if self._idle_percent() >= min_idle:
             self._reset_window()

@@ -31,6 +31,9 @@ REAL_TIME = "real"
 VIRTUAL_TIME = "virtual"
 
 
+from . import globalchecks
+
+
 class VirtualClock:
     def __init__(self, mode: str = VIRTUAL_TIME, num_workers: Optional[int] = None):
         assert mode in (REAL_TIME, VIRTUAL_TIME)
@@ -53,7 +56,8 @@ class VirtualClock:
         self._workers = ThreadPoolExecutor(
             max_workers=num_workers, thread_name_prefix="stellar-worker"
         )
-        self._main_thread = threading.current_thread()
+        # reactor thread affinity (GlobalChecks assertThreadIsMain)
+        self._owner_tid = threading.get_ident()
 
     # -- time --------------------------------------------------------------
     def now(self) -> float:
@@ -69,7 +73,10 @@ class VirtualClock:
 
     # -- posting -----------------------------------------------------------
     def post(self, fn: Callable[[], None]) -> None:
-        """Queue fn to run on the next crank (io_service::post)."""
+        """Queue fn to run on the next crank (io_service::post).  Owner
+        thread only (GlobalChecks.h assertThreadIsMain); workers use
+        post_from_thread."""
+        globalchecks.assert_thread_is(self._owner_tid)
         self._queue.append(fn)
 
     def post_from_thread(self, fn: Callable[[], None]) -> None:
@@ -128,8 +135,10 @@ class VirtualClock:
 
         Mirrors VirtualClock::crank (util/Timer.cpp): drain posted work, poll
         IO, fire due timers; in VIRTUAL mode, if idle, jump time to the next
-        deadline and fire it.
+        deadline and fire it.  Owner thread only (Timer.cpp calls
+        assertThreadIsMain at its crank entry).
         """
+        globalchecks.assert_thread_is(self._owner_tid)
         if self._stopped:
             return 0
         n = 0

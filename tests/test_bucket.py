@@ -246,3 +246,84 @@ def test_bucket_apply_to_db(app):
     b2 = Bucket.fresh(bm, [], [ledger_key_of(live[0])])
     b2.apply(app.database)
     assert AccountFrame.load_account(live[0].data.value.accountID, app.database) is None
+
+
+class TestSkipValues:
+    """calculate_skip_values rotation, pinned to the reference's
+    BucketManagerTest (/root/reference/src/bucket/BucketTests.cpp:100-176)."""
+
+    def test_skiplist_rotation_matches_reference(self, tmp_path):
+        import hashlib
+
+        from stellar_tpu.bucket.manager import BucketManager
+        from stellar_tpu.xdr.ledger import LedgerHeader
+
+        bm = BucketManager.__new__(BucketManager)  # no app needed
+        S1, S2, S3 = bm.SKIP_1, bm.SKIP_2, bm.SKIP_3
+        h0 = b"\x00" * 32
+        h = [hashlib.sha256(b"h%d" % i).digest() for i in range(8)]
+
+        hdr = LedgerHeader()
+        hdr.ledgerSeq = 5
+        hdr.bucketListHash = h[1]
+        bm.calculate_skip_values(hdr)
+        assert hdr.skipList == [h0, h0, h0, h0]
+
+        hdr.ledgerSeq = S1
+        hdr.bucketListHash = h[2]
+        bm.calculate_skip_values(hdr)
+        assert hdr.skipList == [h[2], h0, h0, h0]
+
+        hdr.ledgerSeq = S1 * 2
+        hdr.bucketListHash = h[3]
+        bm.calculate_skip_values(hdr)
+        assert hdr.skipList == [h[3], h0, h0, h0]
+
+        hdr.ledgerSeq = S1 * 2 + 1
+        hdr.bucketListHash = h[2]
+        bm.calculate_skip_values(hdr)
+        assert hdr.skipList == [h[3], h0, h0, h0]
+
+        hdr.ledgerSeq = S2
+        hdr.bucketListHash = h[4]
+        bm.calculate_skip_values(hdr)
+        assert hdr.skipList == [h[4], h0, h0, h0]
+
+        hdr.ledgerSeq = S2 + S1
+        hdr.bucketListHash = h[5]
+        bm.calculate_skip_values(hdr)
+        assert hdr.skipList == [h[5], h[4], h0, h0]
+
+        hdr.ledgerSeq = S3 + S2
+        hdr.bucketListHash = h[6]
+        bm.calculate_skip_values(hdr)
+        assert hdr.skipList == [h[6], h[4], h0, h0]
+
+        hdr.ledgerSeq = S3 + S2 + S1
+        hdr.bucketListHash = h[7]
+        bm.calculate_skip_values(hdr)
+        assert hdr.skipList == [h[7], h[6], h[4], h0]
+
+    def test_skiplist_written_at_close(self, tmp_path):
+        """Headers carry a rotated skipList once ledgerSeq crosses SKIP_1 —
+        exercised through the real close path."""
+        from stellar_tpu.main.application import Application
+        from stellar_tpu.tx import testutils as T
+        from stellar_tpu.util.clock import VIRTUAL_TIME, VirtualClock
+
+        clock = VirtualClock(VIRTUAL_TIME)
+        cfg = T.get_test_config(75)
+        cfg.MANUAL_CLOSE = False
+        app = Application.create(clock, cfg, new_db=True)
+        try:
+            lm = app.ledger_manager
+            app.herder.bootstrap()
+            assert clock.crank_until(
+                lambda: lm.get_last_closed_ledger_num() >= 52, 400
+            )
+            hdr = lm.last_closed.header
+            assert hdr.skipList[0] != b"\x00" * 32  # rotated at seq 50
+            assert hdr.skipList[1:] == [b"\x00" * 32] * 3
+        finally:
+            app.graceful_stop()
+            clock.shutdown()

@@ -164,3 +164,28 @@ class TestLoadManager:
         for i in range(LRU_SIZE + 50):
             lm.get_peer_costs(i.to_bytes(32, "big"))
         assert len(lm._costs) == LRU_SIZE
+
+
+    def test_disabled_shedding_keeps_window_fresh(self, clock):
+        """With MINIMUM_IDLE_PERCENT=0 the busy-window accounting must keep
+        resetting; enabling shedding later then judges only recent activity,
+        not process-lifetime busyness (advisor r1/r2 finding)."""
+        import time as _t
+
+        app = make_app(clock, 46)
+        app.config.MINIMUM_IDLE_PERCENT = 0
+        lm = LoadManager(app)
+        lm._note_busy(100.0)  # pretend a huge historic busy burst
+        _t.sleep(0.01)
+        lm.maybe_shed_excess_load()  # disabled: must reset the window
+        assert lm._busy_seconds == 0.0
+        # now enable with an empty recent window: an idle node must not shed
+        app.config.MINIMUM_IDLE_PERCENT = 50
+
+        class ExplodingOverlay:
+            def get_peers(self):
+                raise AssertionError("idle node tried to shed a peer")
+
+        app.overlay_manager = ExplodingOverlay()
+        _t.sleep(0.01)
+        lm.maybe_shed_excess_load()  # idle_percent ~100 >= 50: no shedding
