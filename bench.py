@@ -77,7 +77,155 @@ def main():
         "speedup_vs_libsodium_core": round(rate / cpu_rate, 2),
         "device": _device_kind(),
     }
+    if os.environ.get("BENCH_SKIP_CLOSE", "0") != "1":
+        result.update(
+            bench_ledger_close(
+                n_txs=int(os.environ.get("BENCH_CLOSE_TXS", "5000")),
+                n_ledgers=int(os.environ.get("BENCH_CLOSE_LEDGERS", "3")),
+            )
+        )
     print(json.dumps(result))
+
+
+def bench_ledger_close(n_txs=5000, n_ledgers=3):
+    """p50/p95 wall time to validate + close a ledger carrying an
+    ``n_txs``-transaction TxSet of single-sig payments (BASELINE.md's
+    second headline metric; harness shape follows the reference's
+    /root/reference/src/ledger/LedgerPerformanceTests.cpp:149-225:
+    pre-create accounts, then time the close loop).
+
+    The timed scope covers TxSetFrame.check_valid (signature batch through
+    the configured SigBackend — the TPU path when a chip is present) plus
+    LedgerManager.close_ledger (apply, buckets, header, SQL commit)."""
+    import statistics
+
+    import jax
+
+    from stellar_tpu.herder.ledgerclose import LedgerCloseData
+    from stellar_tpu.herder.txset import TxSetFrame
+    from stellar_tpu.tx import testutils as T
+    from stellar_tpu.util.clock import VirtualClock
+    from stellar_tpu.main.application import Application
+    from stellar_tpu.xdr import txs as X
+    from stellar_tpu.xdr.ledger import StellarValue
+
+    backend = "tpu" if jax.default_backend() == "tpu" else "cpu"
+    cfg = T.get_test_config(97, backend=backend)
+    cfg.DESIRED_MAX_TX_PER_LEDGER = n_txs * 2
+    clock = VirtualClock()
+    app = Application.create(clock, cfg, new_db=True)
+    try:
+        from stellar_tpu.ledger.accountframe import AccountFrame
+
+        from stellar_tpu.xdr.ledger import (
+            LedgerUpgrade,
+            LedgerUpgradeType,
+        )
+        from stellar_tpu.xdr.base import xdr_to_opaque
+
+        lm = app.ledger_manager
+        root = T.root_key_for(app)
+
+        # genesis maxTxSetSize is the protocol's 100; raise it the protocol
+        # way — a MAX_TX_SET_SIZE ledger upgrade in the first closed value
+        up = xdr_to_opaque(
+            LedgerUpgrade(
+                LedgerUpgradeType.LEDGER_UPGRADE_MAX_TX_SET_SIZE, n_txs * 2
+            )
+        )
+        upgrades = [up]
+
+        # setup ledger(s): create n_txs+1 accounts, 100 create-ops per tx
+        accounts = [T.get_account(i + 1) for i in range(n_txs + 1)]
+        seq = AccountFrame.load_account(
+            root.get_public_key(), app.database
+        ).get_seq_num()
+        created_at = {}
+        for start in range(0, len(accounts), 2000):
+            batch = accounts[start : start + 2000]
+            txs = []
+            for i in range(0, len(batch), 100):
+                seq += 1
+                txs.append(
+                    T.tx_from_ops(
+                        app,
+                        root,
+                        seq,
+                        [
+                            T.create_account_op(a, 10**10)
+                            for a in batch[i : i + 100]
+                        ],
+                    )
+                )
+            txset = TxSetFrame(lm.last_closed.hash, txs)
+            txset.sort_for_hash()
+            assert txset.check_valid(app)
+            sv = StellarValue(
+                txset.get_contents_hash(),
+                lm.last_closed.header.scpValue.closeTime + 5,
+                upgrades,
+                0,
+            )
+            upgrades = []
+            lm.close_ledger(
+                LedgerCloseData(lm.current.header.ledgerSeq, txset, sv)
+            )
+            for a in batch:
+                created_at[a.get_strkey_public()] = (
+                    lm.last_closed.header.ledgerSeq
+                )
+
+        # compile warm-up: the signature prewarm batches n_txs triples into
+        # a pow-2 bucket the verifier has not compiled yet; pay that once,
+        # untimed, with synthetic triples (distinct keys — no cache overlap)
+        from stellar_tpu.crypto.keys import SecretKey as SK
+
+        warm = []
+        for i in range(n_txs):
+            k = SK.pseudo_random_for_testing(10_000_000 + i)
+            m = b"warmup %d" % i
+            warm.append((k.public_raw, m, k.sign(m)))
+        app.sig_backend.verify_batch(warm)
+
+        # timed ledgers: n_txs single-sig payments from distinct accounts
+        times = []
+        for j in range(n_ledgers):
+            txs = []
+            for i in range(n_txs):
+                src = accounts[i]
+                dst = accounts[i + 1]
+                s = (created_at[src.get_strkey_public()] << 32) + 1 + j
+                txs.append(
+                    T.tx_from_ops(app, src, s, [T.payment_op(dst, 1000)])
+                )
+            txset = TxSetFrame(lm.last_closed.hash, txs)
+            txset.sort_for_hash()
+            t0 = time.perf_counter()
+            ok = txset.check_valid(app)
+            sv = StellarValue(
+                txset.get_contents_hash(),
+                lm.last_closed.header.scpValue.closeTime + 5,
+                [],
+                0,
+            )
+            lm.close_ledger(
+                LedgerCloseData(lm.current.header.ledgerSeq, txset, sv)
+            )
+            times.append(time.perf_counter() - t0)
+            assert ok, "payment txset must validate"
+        times.sort()
+        p50 = statistics.median(times)
+        p95 = times[min(len(times) - 1, int(0.95 * len(times)))]
+        return {
+            "ledger_close_p50_ms": round(p50 * 1e3, 1),
+            "ledger_close_p95_ms": round(p95 * 1e3, 1),
+            "ledger_close_txs": n_txs,
+            "ledger_close_ledgers": n_ledgers,
+            "ledger_close_sig_backend": backend,
+        }
+    finally:
+        app.graceful_stop()
+        clock.shutdown()
 
 
 def _device_kind():
