@@ -4,7 +4,8 @@
 A minimal HTTP/1.0 GET server running on the node's VirtualClock selector
 (same single-reactor model as the overlay).  Routes mirror the reference:
 /info /metrics /peers /scp /tx /manualclose /connect /ll /catchup
-/maintenance /dropcursor /setcursor /logrotate /generateload /checkpoint.
+/maintenance /dropcursor /setcursor /checkdb /logrotate /generateload
+/checkpoint /testacc /testtx.
 Submit transactions with ``/tx?blob=<hex XDR TransactionEnvelope>``.
 """
 
@@ -46,6 +47,8 @@ class CommandHandler:
             "checkpoint": self.handle_checkpoint,
             "checkdb": self.handle_checkdb,
             "generateload": self.handle_generateload,
+            "testacc": self.handle_testacc,
+            "testtx": self.handle_testtx,
             "logrotate": lambda q: {"status": "ok"},
         }
 
@@ -318,6 +321,79 @@ class CommandHandler:
         hm = self.app.history_manager
         n = hm.publish_queued_history() if hasattr(hm, "publish_queued_history") else 0
         return {"status": "ok", "publishing": n}
+
+    def _test_key(self, name: str):
+        """'root' or a named deterministic test account
+        (CommandHandler.cpp:131-137 getRoot/getAccount)."""
+        from ..tx import testutils as T
+
+        if name == "root":
+            return T.root_key_for(self.app)
+        return T.get_account(name)
+
+    def handle_testacc(self, q: dict) -> dict:
+        """Inspect a named test account (CommandHandler.cpp:117-150)."""
+        from ..crypto import PubKeyUtils
+        from ..ledger.accountframe import AccountFrame
+
+        name = q.get("name")
+        if not name:
+            return {
+                "status": "error",
+                "detail": "Bad HTTP GET: try something like: testacc?name=bob",
+            }
+        key = self._test_key(name)
+        acc = AccountFrame.load_account(key.get_public_key(), self.app.database)
+        out = {"name": name, "id": PubKeyUtils.to_strkey(key.get_public_key())}
+        if acc is not None:
+            out["balance"] = acc.get_balance()
+            out["seqnum"] = acc.get_seq_num()
+        return out
+
+    def handle_testtx(self, q: dict) -> dict:
+        """Submit a payment / create-account between named test accounts
+        (CommandHandler.cpp:152-231)."""
+        from ..crypto import PubKeyUtils
+        from ..ledger.accountframe import AccountFrame
+        from ..tx import testutils as T
+
+        to, frm, amount = q.get("to"), q.get("from"), q.get("amount")
+        if not (to and frm and amount):
+            return {
+                "status": "error",
+                "detail": "Bad HTTP GET: try something like: "
+                "testtx?from=root&to=bob&amount=100000000&create=true",
+            }
+        to_key = self._test_key(to)
+        from_key = self._test_key(frm)
+        amount = int(amount)
+        src = AccountFrame.load_account(
+            from_key.get_public_key(), self.app.database
+        )
+        # consider txs already pending in the herder, or a second testtx
+        # inside one ledger window would reuse the seq and get txBAD_SEQ
+        db_seq = src.get_seq_num() if src else 0
+        pending = self.app.herder.get_max_seq_in_pending_txs(
+            from_key.get_public_key()
+        )
+        from_seq = max(db_seq, pending) + 1
+        if q.get("create") == "true":
+            op = T.create_account_op(to_key, amount)
+        else:
+            op = T.payment_op(to_key, amount)
+        tx = T.tx_from_ops(self.app, from_key, from_seq, [op])
+        status = self.app.herder.recv_transaction(tx)
+        out = {
+            "from_name": frm,
+            "to_name": to,
+            "from_id": PubKeyUtils.to_strkey(from_key.get_public_key()),
+            "to_id": PubKeyUtils.to_strkey(to_key.get_public_key()),
+            "amount": amount,
+            "status": status,
+        }
+        if status == "ERROR":
+            out["detail"] = xdr_to_opaque(tx.result).hex()
+        return out
 
     def handle_generateload(self, q: dict) -> dict:
         from ..simulation.loadgen import LoadGenerator

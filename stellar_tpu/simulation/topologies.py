@@ -93,3 +93,31 @@ def hierarchical_quorum_simplified(
         sim.add_node(x, qset)
         sim.add_pending_connection(x, ck[i % core_n])
     return sim
+
+
+def hierarchical_quorum(
+    n_branches: int = 2,
+    clock: Optional[VirtualClock] = None,
+) -> Simulation:
+    """Full nested hierarchicalQuorum — 'Figure 3 from the paper'
+    (Topologies::hierarchicalQuorum, Topologies.cpp:114-176): a 4-node core
+    (threshold 3) plus ``n_branches`` middle-tier validators, each with the
+    NESTED quorum set {threshold 2: [self, {threshold 2: core}]} — the only
+    topology that exercises inner-set evaluation in live consensus."""
+    sim = Simulation(OVER_LOOPBACK, clock)
+    ck = _keys(4)
+    core_qset = SCPQuorumSet(3, [x.get_public_key() for x in ck], [])
+    for x in ck:
+        sim.add_node(x, core_qset)
+    for i in range(4):
+        for j in range(i + 1, 4):
+            sim.add_pending_connection(ck[i], ck[j])
+    top_tier = SCPQuorumSet(2, [x.get_public_key() for x in ck], [])
+    for i in range(n_branches):
+        mk = SecretKey.pseudo_random_for_testing(200 + i)
+        # self + any 2 from the top tier, as a nested inner set
+        qset = SCPQuorumSet(2, [mk.get_public_key()], [top_tier])
+        sim.add_node(mk, qset)
+        for c in ck:
+            sim.add_pending_connection(mk, c)
+    return sim

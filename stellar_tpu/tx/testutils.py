@@ -49,9 +49,12 @@ def root_key_for(app) -> SecretKey:
 
 def get_account(n) -> SecretKey:
     if isinstance(n, str):
-        from ..crypto import sha256
-
-        return SecretKey.from_seed(sha256(n.encode()))
+        # reference TxTests::getAccount (TxTests.cpp:200-208): the name
+        # itself, stretched to 32 bytes with '.', IS the seed — same
+        # account IDs as stellar-core's testacc/testtx for the same name
+        seed = n.encode()
+        seed = (seed + b"." * 32)[:32]
+        return SecretKey.from_seed(seed)
     return SecretKey.pseudo_random_for_testing(n)
 
 

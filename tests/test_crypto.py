@@ -195,3 +195,72 @@ class TestEcdh:
         # ordering matters: both-first disagrees
         k_bad = ecdh.ecdh_derive_shared_key(b_sec, b_pub, a_pub, local_first=True)
         assert k_ab != k_bad
+
+
+class TestBase58:
+    """Reference vectors from /root/reference/src/crypto/CryptoTests.cpp:137-189."""
+
+    VECTORS = [
+        (bytes([97] * 32), "7Z8ftDAzMvoyXnGEJye8DurzgQQXLAbYCaeeesM7UKHa"),
+        (b"abcd" * 8, "7Z9ZajDvyzs9sYf85A9gAAYxcmHYSbWsGNLrZ3rzLAeP"),
+        (bytes([0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 0x1A, 0x1B, 0x1C, 0x1D, 0x1E,
+                0x1F]), "12drXXUifSrRnfLCV62Ht"),
+        (b"", ""),
+        (b"\x00", "1"),
+        (b"\x00\x00", "11"),
+        (bytes(32), "11111111111111111111111111111111"),
+        (b"\xff", "5Q"),
+        (b"\xff\xff", "LUv"),
+        (b"\xff\xff\xff", "2UzHL"),
+        (b"\x01", "2"),
+        (b"\x01\x01", "5S"),
+        (bytes([0x01, 0x01, 0xFF, 0x00]), "2VfAo"),
+        (bytes([0xB4, 0xDA, 0x4A, 0x70, 0xA7, 0x61, 0xCA, 0x41, 0x69, 0x33,
+                0x5D, 0xC0, 0x2B, 0xD3, 0xA6, 0x58]), "PLHQNH1Kpm1w5WN9QSQJko"),
+        (bytes([0x52, 0xDF, 0x8C, 0xA2, 0x80, 0xA7, 0x0D, 0xA1, 0x3D, 0xC0,
+                0xF8, 0x76, 0x00, 0x80, 0x3E, 0x81]), "BEYde8cpJw3kKZEX29eWaC"),
+        (bytes([0x2F, 0x28, 0xED, 0xFC, 0xAE, 0x85, 0x07, 0xAF, 0x0F, 0x4A,
+                0xEC, 0xBD, 0x6A, 0x98, 0x55, 0xBB]), "6pmGMkyWgwasgS1VmiM4U2"),
+        (bytes([0xDB, 0x95, 0xC5, 0x32, 0x28, 0x43, 0xDC, 0x9B, 0xB2, 0x34,
+                0xC3, 0x23, 0x30, 0xFC, 0xA5, 0x11]), "U7grozkGcCERSK7owUsJXa"),
+        (bytes([0xC4, 0x2A, 0x64, 0x0C, 0x71, 0xF7, 0x22, 0xDD, 0x4A, 0x93,
+                0x6C, 0xA1, 0xA3, 0x1B, 0x51, 0x82]), "RDxPrFYS9Cru3n79e6ahi1"),
+        (bytes([0xE1, 0xC1, 0x7C, 0x47, 0x5A, 0x82, 0x43, 0x55, 0x6C, 0xD5,
+                0x5B, 0x12, 0xB6, 0x98, 0x1C, 0x83]), "UstCbvfvLMCshNmbGSGYnn"),
+    ]
+
+    def test_reference_vectors(self):
+        from stellar_tpu.crypto import base58 as b58
+
+        for raw, enc in self.VECTORS:
+            assert b58.base_encode(raw) == enc, raw
+            assert b58.base_decode(enc) == raw, enc
+
+    def test_random_roundtrip_both_alphabets(self):
+        import random
+
+        from stellar_tpu.crypto import base58 as b58
+
+        rng = random.Random(6)
+        for alphabet in (b58.BITCOIN_ALPHABET, b58.STELLAR_ALPHABET):
+            for _ in range(40):
+                raw = bytes(
+                    rng.randrange(256) for _ in range(rng.randrange(0, 64))
+                )
+                assert b58.base_decode(
+                    b58.base_encode(raw, alphabet), alphabet
+                ) == raw
+
+    def test_check_encoding_roundtrip_and_tamper(self):
+        import pytest as _pytest
+
+        from stellar_tpu.crypto import base58 as b58
+
+        payload = bytes(range(32))
+        enc = b58.base_check_encode(b58.VER_ACCOUNT_ID, payload)
+        assert enc.startswith("g")  # version byte 0 -> 'g' in stellar alphabet
+        ver, out = b58.base_check_decode(enc)
+        assert (ver, out) == (b58.VER_ACCOUNT_ID, payload)
+        bad = enc[:-1] + ("x" if enc[-1] != "x" else "y")
+        with _pytest.raises(ValueError):
+            b58.base_check_decode(bad)

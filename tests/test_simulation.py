@@ -66,6 +66,19 @@ def test_hierarchical_quorum():
     sim.stop_all_nodes()
 
 
+def test_hierarchical_quorum_nested():
+    """Full nested hierarchicalQuorum (Topologies.cpp:114): middle-tier
+    validators run a quorum set with a real inner set {2: [self, {2:
+    core}]} and must externalize in lockstep with the core — the only
+    live-consensus exercise of nested qset evaluation."""
+    sim = topologies.hierarchical_quorum(n_branches=2)
+    sim.start_all_nodes()
+    ok = sim.crank_until(lambda: sim.have_all_externalized(3), 300)
+    assert ok, f"nodes stuck at {sim.ledger_nums()}"
+    assert sim.all_ledgers_agree()
+    sim.stop_all_nodes()
+
+
 def test_load_generator_drives_consensus():
     """[stress100]-style: synthetic load over a 2-node net; balances land."""
     sim = topologies.pair()
