@@ -19,6 +19,24 @@ import sys
 import time
 
 
+def _retry(fn, attempts=3, wait=20.0, tag=""):
+    """The axon TPU relay occasionally drops a remote_compile/execute mid
+    stream ('response body closed', HTTP 500); one retry after a pause
+    almost always succeeds.  Benchmark runs must not go red for that."""
+    for k in range(attempts):
+        try:
+            return fn()
+        except Exception as e:  # pragma: no cover - relay-dependent
+            if k == attempts - 1:
+                raise
+            print(
+                f"# bench retry {k + 1}/{attempts - 1} after {tag or 'error'}:"
+                f" {e}",
+                file=sys.stderr,
+            )
+            time.sleep(wait)
+
+
 def bench_libsodium_single_core(items, seconds=1.0):
     from stellar_tpu.crypto import sodium
 
@@ -53,13 +71,13 @@ def main():
     items = items * nchunks
     bv = BatchVerifier(max_batch=batch)
     # warmup + compile
-    out = bv.verify(items[:batch])
+    out = _retry(lambda: bv.verify(items[:batch]), tag="warmup/compile")
     assert all(out), "benchmark signatures must all verify"
 
     best = 0.0
     for _ in range(iters):
         t0 = time.perf_counter()
-        out = bv.verify(items)
+        out = _retry(lambda: bv.verify(items), tag="verify pass")
         dt = time.perf_counter() - t0
         assert all(out)
         best = max(best, len(items) / dt)
@@ -78,12 +96,15 @@ def main():
         "device": _device_kind(),
     }
     if os.environ.get("BENCH_SKIP_CLOSE", "0") != "1":
-        result.update(
-            bench_ledger_close(
-                n_txs=int(os.environ.get("BENCH_CLOSE_TXS", "5000")),
-                n_ledgers=int(os.environ.get("BENCH_CLOSE_LEDGERS", "3")),
+        try:
+            result.update(
+                bench_ledger_close(
+                    n_txs=int(os.environ.get("BENCH_CLOSE_TXS", "5000")),
+                    n_ledgers=int(os.environ.get("BENCH_CLOSE_LEDGERS", "3")),
+                )
             )
-        )
+        except Exception as e:  # the verify headline must still be reported
+            result["ledger_close_error"] = str(e)[:200]
     print(json.dumps(result))
 
 
