@@ -8,6 +8,7 @@ encode to exactly 56 chars with no padding ('G...' pubkeys, 'S...' seeds).
 from __future__ import annotations
 
 import base64
+from functools import lru_cache
 from typing import Tuple
 
 # 5-bit version bytes (StrKey.h:18-20)
@@ -15,14 +16,27 @@ STRKEY_PUBKEY_ED25519 = 6  # 'G'
 STRKEY_SEED_ED25519 = 18  # 'S'
 
 
-def crc16(data: bytes) -> int:
-    """CRC16-CCITT XModem: poly 0x1021, init 0 (lib/util/crc16.cpp)."""
-    crc = 0
-    for b in data:
-        crc ^= b << 8
+def _crc16_table() -> list:
+    tab = []
+    for hi in range(256):
+        crc = hi << 8
         for _ in range(8):
             crc = ((crc << 1) ^ 0x1021) if crc & 0x8000 else (crc << 1)
-        crc &= 0xFFFF
+        tab.append(crc & 0xFFFF)
+    return tab
+
+
+_CRC16_TAB = _crc16_table()
+
+
+def crc16(data: bytes) -> int:
+    """CRC16-CCITT XModem: poly 0x1021, init 0 (lib/util/crc16.cpp);
+    byte-wise table lookup (the bit-loop was the hottest non-SQL function
+    in the ledger-close profile — strkeys are SQL row keys)."""
+    crc = 0
+    tab = _CRC16_TAB
+    for b in data:
+        crc = ((crc << 8) & 0xFFFF) ^ tab[(crc >> 8) ^ b]
     return crc
 
 
@@ -48,10 +62,15 @@ def from_strkey(s: str) -> Tuple[int, bytes]:
     return body[0] >> 3, body[1:]
 
 
+# Only the ACCOUNT paths are cached: they are the ledger's SQL row keys
+# (hot in the close path), and caching the generic functions would retain
+# secret 'S...' seeds in a long-lived global dict.
+@lru_cache(maxsize=65536)
 def to_account_strkey(pubkey: bytes) -> str:
     return to_strkey(STRKEY_PUBKEY_ED25519, pubkey)
 
 
+@lru_cache(maxsize=65536)
 def from_account_strkey(s: str) -> bytes:
     ver, payload = from_strkey(s)
     if ver != STRKEY_PUBKEY_ED25519 or len(payload) != 32:

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Set
 
+from ..xdr.base import xdr_copy
 from ..xdr.entries import LedgerEntry
 from ..xdr.ledger import (
     LedgerEntryChange,
@@ -184,13 +185,15 @@ class LedgerDelta:
 
 
 def _copy_entry(e: LedgerEntry) -> LedgerEntry:
-    return LedgerEntry.from_xdr(e.to_xdr())
+    return xdr_copy(e)  # codec-driven; no serialization round-trip
 
 
 def _copy_header(h):
-    from ..xdr.ledger import LedgerHeader
-
-    return LedgerHeader.from_xdr(h.to_xdr())
+    """Codec-driven copy — called ~9x per applied transaction (one per
+    nested delta), where an XDR serialization round-trip was ~25% of
+    ledger-close time.  xdr_copy stays in sync with the LedgerHeader
+    field list automatically."""
+    return xdr_copy(h)
 
 
 def _assign_header(dst, src) -> None:

@@ -11,12 +11,17 @@ from __future__ import annotations
 from collections import OrderedDict
 from typing import Optional
 
+from ..xdr.base import xdr_copy
 from ..xdr.entries import LedgerEntry, LedgerEntryType
 from ..xdr.ledger import LedgerKey
 
 
 class EntryCache:
-    """Small LRU of key-xdr -> Optional[LedgerEntry-xdr] (None = known-absent)."""
+    """Small LRU of key-xdr -> Optional[LedgerEntry-xdr] (None = known-absent).
+
+    Stores XDR bytes: measured FASTER than caching decoded objects, because
+    an object cache must deep-copy on both store and hit (aliasing safety)
+    while the bytes cache packs once per store and decodes once per hit."""
 
     CAPACITY = 4096
 
@@ -79,7 +84,7 @@ class EntryFrame:
         self.entry.lastModifiedLedgerSeq = seq
 
     def copy(self) -> "EntryFrame":
-        return type(self)(LedgerEntry.from_xdr(self.entry.to_xdr()))
+        return type(self)(xdr_copy(self.entry))
 
     # -- store interface (implemented by subclasses) -----------------------
     def store_add(self, delta, db) -> None:

@@ -23,7 +23,9 @@ class LedgerHeaderFrame:
     @classmethod
     def from_previous(cls, prev: "LedgerHeaderFrame") -> "LedgerHeaderFrame":
         """Next-ledger template (LedgerHeaderFrame ctor from previous)."""
-        h = LedgerHeader.from_xdr(prev.header.to_xdr())
+        from ..xdr.base import xdr_copy
+
+        h = xdr_copy(prev.header)
         h.previousLedgerHash = prev.get_hash()
         h.ledgerSeq = prev.header.ledgerSeq + 1
         return cls(h)

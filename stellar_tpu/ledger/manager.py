@@ -13,7 +13,7 @@ from typing import Callable, Dict, List, Optional
 from ..crypto import sha256
 from ..crypto.keys import SecretKey
 from ..util import xlog
-from ..xdr.base import XdrError
+from ..xdr.base import xdr_copy, XdrError
 from ..xdr.ledger import (
     LedgerHeader,
     LedgerUpgrade,
@@ -347,7 +347,7 @@ class LedgerManager:
     def _advance_ledger_pointers(self) -> None:
         self.last_closed = LastClosedLedger(
             self.current.get_hash(),
-            LedgerHeader.from_xdr(self.current.header.to_xdr()),
+            xdr_copy(self.current.header),
         )
         self.current = LedgerHeaderFrame.from_previous(self.current)
 
