@@ -20,6 +20,12 @@ from ..xdr.ledger import LedgerKey, LedgerKeyAccount
 from .entryframe import EntryFrame
 
 
+_ACCT_KEY_PREFIX = LedgerKey(
+    LedgerEntryType.ACCOUNT,
+    LedgerKeyAccount(PublicKey.from_ed25519(b"\x00" * 32)),
+).to_xdr()[:-32]
+
+
 def _aid(pk: PublicKey) -> str:
     return strkey.to_account_strkey(pk.value)
 
@@ -172,8 +178,12 @@ class AccountFrame(EntryFrame):
 
     @classmethod
     def load_account(cls, account_id: PublicKey, db) -> Optional["AccountFrame"]:
+        # account cache keys are prefix+pubkey on the wire; building the
+        # bytes directly skips two XDR packs on the hottest load path
+        kb = _ACCT_KEY_PREFIX + account_id.value
         key = LedgerKey(LedgerEntryType.ACCOUNT, LedgerKeyAccount(account_id))
-        hit, cached = cls.cache_of(db).get(key.to_xdr())
+        key._kb = kb
+        hit, cached = cls.cache_of(db).get(kb)
         if hit:
             return cls(LedgerEntry.from_xdr(cached)) if cached else None
         aid = _aid(account_id)

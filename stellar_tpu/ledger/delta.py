@@ -59,7 +59,9 @@ class LedgerDelta:
 
     # -- entry recording (LedgerDelta.cpp addEntry/modEntry/deleteEntry) ----
     def _remember_key(self, key: LedgerKey) -> bytes:
-        kb = key.to_xdr()
+        from .entryframe import key_bytes
+
+        kb = key_bytes(key)
         self._key_objs[kb] = key
         return kb
 

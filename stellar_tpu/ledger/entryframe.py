@@ -47,6 +47,16 @@ class EntryCache:
         self._map.clear()
 
 
+def key_bytes(key: LedgerKey) -> bytes:
+    """Memoized XDR encoding of a LedgerKey — cache/delta row keys are
+    derived repeatedly from the same key objects in the apply path."""
+    kb = getattr(key, "_kb", None)
+    if kb is None:
+        kb = key.to_xdr()
+        key._kb = kb
+    return kb
+
+
 def entry_cache_of(db) -> EntryCache:
     cache = getattr(db, "_entry_cache", None)
     if cache is None:
@@ -108,12 +118,12 @@ class EntryFrame:
     @classmethod
     def store_in_cache(cls, db, key: LedgerKey, entry: Optional[LedgerEntry]):
         entry_cache_of(db).put(
-            key.to_xdr(), entry.to_xdr() if entry is not None else None
+            key_bytes(key), entry.to_xdr() if entry is not None else None
         )
 
     @classmethod
     def flush_cached(cls, db, key: LedgerKey):
-        entry_cache_of(db).erase(key.to_xdr())
+        entry_cache_of(db).erase(key_bytes(key))
 
     @staticmethod
     def check_exists(db, sql: str, params) -> bool:
