@@ -12,6 +12,7 @@ from typing import Dict, List, Optional, Set
 
 from ..xdr.base import xdr_copy
 from ..xdr.entries import LedgerEntry
+from .entryframe import key_bytes
 from ..xdr.ledger import (
     LedgerEntryChange,
     LedgerEntryChangeType,
@@ -59,8 +60,6 @@ class LedgerDelta:
 
     # -- entry recording (LedgerDelta.cpp addEntry/modEntry/deleteEntry) ----
     def _remember_key(self, key: LedgerKey) -> bytes:
-        from .entryframe import key_bytes
-
         kb = key_bytes(key)
         self._key_objs[kb] = key
         return kb

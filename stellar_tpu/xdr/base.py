@@ -367,26 +367,129 @@ def xf(codec: XdrCodec, default: Any = dataclasses.MISSING, factory: Any = None)
     return dataclasses.field(**kw)
 
 
+def _fixed_leaf(codec):
+    """(struct-format, byte-check-n, enum-cls) for codecs packable inside a
+    single struct.Struct run, else None.  Opaque[n%4==0] needs an explicit
+    length check ('Ns' silently pads short values); enums pack their int
+    value and keep decode-side validation."""
+    if isinstance(codec, _UInt32):
+        return ("I", None, None)
+    if isinstance(codec, _Int32):
+        return ("i", None, None)
+    if isinstance(codec, _UInt64):
+        return ("Q", None, None)
+    if isinstance(codec, _Int64):
+        return ("q", None, None)
+    if isinstance(codec, _Opaque) and codec.n % 4 == 0:
+        return (f"{codec.n}s", codec.n, None)
+    if isinstance(codec, _Enum):
+        return ("i", None, codec.enum_cls)
+    return None
+
+
 class _StructCodec(XdrCodec):
+    """Derived struct codec with a fast path: maximal runs of fixed-size
+    leaf fields (ints, fixed opaque, enums) pack/unpack through one
+    precompiled struct.Struct instead of per-field codec dispatch — the
+    generic loop was the top ledger-close cost after the copy fixes."""
+
     def __init__(self, cls, fields: List[Tuple[str, XdrCodec]]):
         self.cls = cls
         self.fields = fields
+        # plan items: ("run", Struct, names, checks, enums) | ("one", name, codec)
+        plan = []
+        fmt, names, checks, enums = "", [], [], []
+
+        def flush():
+            nonlocal fmt, names, checks, enums
+            if names:
+                plan.append(
+                    ("run", struct.Struct(">" + fmt), tuple(names),
+                     tuple(checks), tuple(enums))
+                )
+                fmt, names, checks, enums = "", [], [], []
+
+        for name, codec in fields:
+            leaf = _fixed_leaf(codec)
+            if leaf is None:
+                flush()
+                plan.append(("one", name, codec))
+            else:
+                f, n, ecls = leaf
+                fmt += f
+                names.append(name)
+                checks.append((name, n) if n is not None else None)
+                enums.append(ecls)
+        flush()
+        self._plan = plan
 
     def pack_into(self, val, out):
-        for name, codec in self.fields:
-            try:
-                codec.pack_into(getattr(val, name), out)
-            except XdrError:
-                raise
-            except Exception as e:
-                raise XdrError(
-                    f"packing {self.cls.__name__}.{name}: {e}"
-                ) from e
+        for item in self._plan:
+            if item[0] == "run":
+                _, st, names, checks, enums = item
+                for chk in checks:
+                    if chk is not None:
+                        v = getattr(val, chk[0])
+                        if not isinstance(v, (bytes, bytearray)) or len(
+                            v
+                        ) != chk[1]:
+                            raise XdrError(
+                                f"{self.cls.__name__}.{chk[0]}: opaque"
+                                f"[{chk[1]}] needs {chk[1]} bytes, got "
+                                f"{v!r:.32}"
+                            )
+                vals = []
+                for n, ecls in zip(names, enums):
+                    v = getattr(val, n)
+                    if ecls is not None and (
+                        v not in ecls._value2member_map_
+                    ):
+                        # keep _Enum.pack_into's fail-fast contract: a bad
+                        # enum int must never silently reach the wire/hash
+                        raise XdrError(
+                            f"bad {ecls.__name__} value {v!r}"
+                        )
+                    vals.append(v)
+                try:
+                    out += st.pack(*vals)
+                except (struct.error, TypeError, ValueError) as e:
+                    raise XdrError(
+                        f"packing {self.cls.__name__}: {e}"
+                    ) from e
+            else:
+                _, name, codec = item
+                try:
+                    codec.pack_into(getattr(val, name), out)
+                except XdrError:
+                    raise
+                except Exception as e:
+                    raise XdrError(
+                        f"packing {self.cls.__name__}.{name}: {e}"
+                    ) from e
 
     def unpack_from(self, buf, off):
         kw = {}
-        for name, codec in self.fields:
-            kw[name], off = codec.unpack_from(buf, off)
+        for item in self._plan:
+            if item[0] == "run":
+                _, st, names, _, enums = item
+                if off + st.size > len(buf):
+                    raise XdrError(
+                        f"short buffer for {self.cls.__name__}"
+                    )
+                vals = st.unpack_from(buf, off)
+                off += st.size
+                for name, v, ecls in zip(names, vals, enums):
+                    if ecls is not None:
+                        m = ecls._value2member_map_.get(v)
+                        if m is None:
+                            raise XdrError(
+                                f"bad {ecls.__name__} value {v}"
+                            )
+                        v = m
+                    kw[name] = v
+            else:
+                _, name, codec = item
+                kw[name], off = codec.unpack_from(buf, off)
         return self.cls(**kw), off
 
     def copy(self, val):
