@@ -41,20 +41,67 @@ class LoadGenerator:
         self.pending_accounts = 0
         self.pending_txs = 0
         self.rate = 10
+        self.auto_rate = False
+        self._last_second = -1
         self._root_seq = 0
         self._running = False
 
     # -- public api ---------------------------------------------------------
-    def generate_load(self, app, n_accounts: int, n_txs: int, rate: int) -> None:
-        """(CommandHandler 'generateload') queue work and start stepping."""
+    def generate_load(
+        self, app, n_accounts: int, n_txs: int, rate: int,
+        auto_rate: bool = False,
+    ) -> None:
+        """(CommandHandler 'generateload') queue work and start stepping.
+
+        ``auto_rate`` enables the reference's auto-calibration
+        (LoadGenerator.cpp:334-402, the [autoload] mode): once a second
+        the target rate adjusts toward the point where the mean ledger
+        close time sits at half the close cadence."""
         self.pending_accounts += n_accounts
         self.pending_txs += n_txs
         self.rate = max(1, rate)
+        self.auto_rate = auto_rate
         if not self._running:
             self._running = True
             if self.timer is None:
                 self.timer = VirtualTimer(app.clock)
             self._schedule(app)
+
+    # -- auto-rate calibration (LoadGenerator.cpp:172-199, 334-402) ---------
+    def _maybe_adjust_rate(self, target: float, actual: float,
+                           increase_ok: bool) -> bool:
+        if actual == 0.0:
+            actual = 1.0
+        diff = target - actual
+        if abs(diff) <= 0.1 * target:
+            return False
+        pct = min(1.0, diff / actual)  # cap at doubling per adjustment
+        incr = int(pct * self.rate)
+        if incr > 0 and not increase_ok:
+            return False
+        log.info("auto-tx rate %d -> %d", self.rate, self.rate + incr)
+        self.rate = max(1, self.rate + incr)
+        return True
+
+    def _auto_adjust(self, app) -> None:
+        now = int(app.clock.now())
+        if now == self._last_second:
+            return
+        self._last_second = now
+        close_timer = app.metrics.new_timer(("ledger", "ledger", "close"))
+        if app.ledger_manager.get_ledger_num() <= 10 or close_timer.count <= 5:
+            return
+        target_age = 1000.0 if (
+            app.config.ARTIFICIALLY_ACCELERATE_TIME_FOR_TESTING
+        ) else 5000.0
+        # "well loaded" = mean close time near half the ledger cadence
+        self._maybe_adjust_rate(
+            target_age / 2.0, close_timer.histogram.mean, increase_ok=True
+        )
+        if self.rate > 5000:
+            log.warning("auto rate > 5000, likely metric stutter; resetting")
+            self.rate = 10
+        close_timer.histogram.clear()
 
     def is_done(self) -> bool:
         return self.pending_accounts == 0 and self.pending_txs == 0
@@ -69,6 +116,8 @@ class LoadGenerator:
             self._running = False
             log.info("load generation complete (%d accounts live)", len(self.accounts))
             return
+        if self.auto_rate:
+            self._auto_adjust(app)
         budget = max(1, int(self.rate * STEP_SECONDS))
         submitted = 0
         # only count work off the pending totals when the herder accepted

@@ -153,6 +153,15 @@ class Histogram:
     def mean(self) -> float:
         return self._sum / self.count if self.count else 0.0
 
+    def clear(self) -> None:
+        """Reset the reservoir (medida Timer::Clear — the reference's
+        auto-load calibration clears between adjustment periods)."""
+        self.count = 0
+        self._sum = 0.0
+        self._min = None
+        self._max = None
+        self._sample.clear()
+
     def to_json(self):
         return {
             "type": "histogram",

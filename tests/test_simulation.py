@@ -107,3 +107,34 @@ def test_load_generator_drives_consensus():
             landed += 1
     assert landed >= 2
     sim.stop_all_nodes()
+
+
+def test_autoload_calibration():
+    """[autoload] (CoreTests.cpp:294): auto-calibrated single-node load —
+    the generator adjusts its tx rate from the ledger-close timer and
+    completes its run."""
+    from stellar_tpu.main.application import Application
+    from stellar_tpu.simulation.loadgen import LoadGenerator
+    from stellar_tpu.tx import testutils as T
+    from stellar_tpu.util.clock import VIRTUAL_TIME, VirtualClock
+
+    clock = VirtualClock(VIRTUAL_TIME)
+    cfg = T.get_test_config(76)
+    cfg.MANUAL_CLOSE = False
+    cfg.ARTIFICIALLY_ACCELERATE_TIME_FOR_TESTING = True
+    cfg.DESIRED_MAX_TX_PER_LEDGER = 10000
+    app = Application.create(clock, cfg, new_db=True)
+    try:
+        app.herder.bootstrap()
+        app.ledger_manager.current.header.maxTxSetSize = 10000
+        gen = LoadGenerator()
+        gen.generate_load(app, 30, 300, 10, auto_rate=True)
+        ok = clock.crank_until(gen.is_done, 600)
+        assert ok, f"load stuck: {gen.pending_accounts}/{gen.pending_txs}"
+        # the run spanned enough ledgers for calibration to kick in, and
+        # with sub-target close times the rate must have ramped UP
+        assert app.ledger_manager.get_last_closed_ledger_num() > 10
+        assert gen.rate > 10
+    finally:
+        app.graceful_stop()
+        clock.shutdown()
