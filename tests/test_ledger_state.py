@@ -233,3 +233,83 @@ class TestLedgerHeaderFrame:
         h2 = LedgerHeaderFrame.from_previous(h1)
         assert h2.header.ledgerSeq == 2
         assert h2.header.previousLedgerHash == h1.get_hash()
+
+
+class TestCoinConservation:
+    """Property test: across random op-mix ledgers, native coins are
+    conserved — sum(account balances) + feePool == totalCoins
+    (the reference enforces this shape via inflation/fee accounting in
+    LedgerManagerImpl; here it pins our delta/fee/apply plumbing)."""
+
+    def test_random_ops_conserve_coins(self):
+        import random
+
+        from stellar_tpu.herder.ledgerclose import LedgerCloseData
+        from stellar_tpu.herder.txset import TxSetFrame
+        from stellar_tpu.main.application import Application
+        from stellar_tpu.tx import testutils as T
+        from stellar_tpu.util.clock import VirtualClock
+        from stellar_tpu.xdr import txs as X
+        from stellar_tpu.xdr.ledger import StellarValue
+
+        rng = random.Random(77)
+        clock = VirtualClock()
+        app = Application.create(clock, T.get_test_config(78), new_db=True)
+        try:
+            lm = app.ledger_manager
+            root = T.root_key_for(app)
+            keys = [T.get_account(i + 1) for i in range(6)]
+            seqs = {}
+
+            def conserved():
+                total = app.database.query_one(
+                    "SELECT SUM(balance) FROM accounts"
+                )[0]
+                hdr = lm.last_closed.header
+                assert total + hdr.feePool == hdr.totalCoins, (
+                    total, hdr.feePool, hdr.totalCoins
+                )
+
+            def close(txs):
+                txset = TxSetFrame(lm.last_closed.hash, txs)
+                txset.sort_for_hash()
+                txset.trim_invalid(app)
+                sv = StellarValue(
+                    txset.get_contents_hash(),
+                    lm.last_closed.header.scpValue.closeTime + 5, [], 0
+                )
+                lm.close_ledger(
+                    LedgerCloseData(lm.current.header.ledgerSeq, txset, sv)
+                )
+
+            # seed accounts
+            rseq = T.root_seq_for = app.database.query_one(
+                "SELECT seqnum FROM accounts WHERE balance = ("
+                "SELECT MAX(balance) FROM accounts)")[0]
+            txs = []
+            for k in keys:
+                rseq += 1
+                txs.append(T.tx_from_ops(
+                    app, root, rseq, [T.create_account_op(k, 10**10)]))
+            close(txs)
+            conserved()
+            created = lm.last_closed.header.ledgerSeq
+            for k in keys:
+                seqs[k.get_strkey_public()] = created << 32
+
+            # 6 ledgers of random payments/creates/merges-less mix
+            for _ in range(6):
+                txs = []
+                for _ in range(rng.randrange(3, 9)):
+                    src = rng.choice(keys)
+                    dst = rng.choice([k for k in keys if k is not src])
+                    sk = src.get_strkey_public()
+                    seqs[sk] += 1
+                    amt = rng.randrange(1, 10**7)
+                    txs.append(T.tx_from_ops(
+                        app, src, seqs[sk], [T.payment_op(dst, amt)]))
+                close(txs)
+                conserved()
+        finally:
+            app.graceful_stop()
+            clock.shutdown()
