@@ -23,7 +23,10 @@ class EntryCache:
     an object cache must deep-copy on both store and hit (aliasing safety)
     while the bytes cache packs once per store and decodes once per hit."""
 
-    CAPACITY = 4096
+    # the reference uses 4096 (EntryFrame.h); a 5000-tx ledger touches
+    # ~2x5000 distinct accounts per close, so that size thrashes exactly
+    # at the benchmark ledger shape — size for the close working set
+    CAPACITY = 131072
 
     def __init__(self):
         self._map: OrderedDict[bytes, Optional[bytes]] = OrderedDict()
