@@ -289,6 +289,8 @@ def main(argv=None) -> int:
         cfg = Config()
         cfg.NETWORK_PASSPHRASE = "Standalone stellar-tpu network"
 
+    if cfg.LOG_FILE_PATH:
+        xlog.add_file(cfg.LOG_FILE_PATH)
     if mode == "forcescp":
         return _set_force_scp(cfg)
     if mode == "newhist":

@@ -49,7 +49,7 @@ class CommandHandler:
             "generateload": self.handle_generateload,
             "testacc": self.handle_testacc,
             "testtx": self.handle_testtx,
-            "logrotate": lambda q: {"status": "ok"},
+            "logrotate": self.handle_logrotate,
         }
 
     # -- server plumbing ----------------------------------------------------
@@ -394,6 +394,12 @@ class CommandHandler:
         if status == "ERROR":
             out["detail"] = xdr_to_opaque(tx.result).hex()
         return out
+
+    def handle_logrotate(self, q: dict) -> dict:
+        """Reopen the log file (reference handler is a stub; ours rotates
+        for real when LOG_FILE_PATH is configured)."""
+        rotated = xlog.rotate()
+        return {"status": "ok", "rotated": rotated}
 
     def handle_generateload(self, q: dict) -> dict:
         from ..simulation.loadgen import LoadGenerator

@@ -53,6 +53,39 @@ def init(level: str = "info", stream=None) -> None:
     set_log_level(level)
 
 
+_file_handler = None
+_file_path = ""
+
+
+def add_file(path: str) -> None:
+    """Attach a log file (Config LOG_FILE_PATH).  Reopenable via rotate()."""
+    global _file_handler, _file_path
+    if not path:
+        return
+    root = logging.getLogger("stellar_tpu")
+    if _file_handler is not None:
+        root.removeHandler(_file_handler)
+        _file_handler.close()
+    _file_path = path
+    _file_handler = logging.FileHandler(path)
+    _file_handler.setFormatter(
+        logging.Formatter(
+            "%(asctime)s %(name)s [%(levelname)s] %(message)s", "%H:%M:%S"
+        )
+    )
+    root.addHandler(_file_handler)
+
+
+def rotate() -> bool:
+    """Close and reopen the log file so an external rotator can move it
+    (the /logrotate admin command; the reference's handler is a stub —
+    CommandHandler.cpp:444 — this one actually reopens)."""
+    if not _file_path:
+        return False
+    add_file(_file_path)
+    return True
+
+
 def logger(partition: str) -> logging.Logger:
     return logging.getLogger(f"stellar_tpu.{partition}")
 

@@ -114,3 +114,32 @@ def test_get_account_matches_reference_seed_stretch():
 
     want = SecretKey.from_seed(b"bob" + b"." * 29)
     assert T.get_account("bob").get_public_key() == want.get_public_key()
+
+
+def test_logrotate_reopens_file(app, tmp_path):
+    """LOG_FILE_PATH + /logrotate: after an external move, logging resumes
+    into a fresh file at the configured path."""
+    import os
+
+    from stellar_tpu.util import xlog
+
+    path = str(tmp_path / "node.log")
+    xlog.add_file(path)
+    try:
+        log = xlog.logger("test")
+        log.error("before rotate")
+        os.rename(path, path + ".1")
+        out = app.command_handler.handle_logrotate({})
+        assert out == {"status": "ok", "rotated": True}
+        log.error("after rotate")
+        assert os.path.exists(path)
+        assert "after rotate" in open(path).read()
+        assert "before rotate" in open(path + ".1").read()
+    finally:
+        import logging
+
+        xlog._file_path = ""
+        if xlog._file_handler is not None:
+            logging.getLogger("stellar_tpu").removeHandler(xlog._file_handler)
+            xlog._file_handler.close()
+            xlog._file_handler = None
