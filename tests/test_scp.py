@@ -544,3 +544,120 @@ class TestNomination:
         round_before = n.scp.get_slot(1).nomination.round_number
         cb()
         assert n.scp.get_slot(1).nomination.round_number == round_before + 1
+
+
+class TestBallotProtocolPorted:
+    """Scenarios ported 1:1 from the reference's core5 suite
+    (/root/reference/src/scp/SCPTests.cpp:535-686)."""
+
+    @staticmethod
+    def _externalized_node():
+        """Drive v0 through the full happy path to EXTERNALIZE on (1,x)."""
+        n = Core5()
+        n.scp.get_slot(1).bump_state(X, force=True)
+        n.recv_quorum(lambda: prepare_st(n.qs_hash, SCPBallot(1, X)))
+        n.recv_quorum(
+            lambda: prepare_st(n.qs_hash, SCPBallot(1, X), prepared=SCPBallot(1, X))
+        )
+        n.recv_quorum(
+            lambda: prepare_st(
+                n.qs_hash, SCPBallot(1, X), prepared=SCPBallot(1, X), nC=1, nP=1
+            )
+        )
+        n.recv_quorum(lambda: confirm_st(n.qs_hash, 1, SCPBallot(1, X), 1))
+        assert n.bp().phase == Phase.EXTERNALIZE
+        assert n.driver.externalized == {1: X}
+        return n
+
+    @pytest.mark.parametrize(
+        "b2",
+        [
+            SCPBallot(1, Y),  # by value
+            SCPBallot(2, X),  # by counter
+            SCPBallot(2, Y),  # by value and counter
+        ],
+        ids=["by-value", "by-counter", "by-both"],
+    )
+    def test_bump_to_ballot_prevented_once_committed(self, b2):
+        """SCPTests.cpp:535-570: once externalized, even a full quorum
+        confirming a different ballot must not move the node or
+        re-externalize."""
+        n = self._externalized_node()
+        emitted_before = len(n.emitted)
+        for i in (1, 2, 3):
+            n.recv(i, confirm_st(n.qs_hash, b2.counter, b2, b2.counter))
+        assert len(n.emitted) == emitted_before
+        assert n.driver.externalized == {1: X}  # exactly one externalize
+        assert n.bp().phase == Phase.EXTERNALIZE
+
+    def test_confirm_range_check(self):
+        """SCPTests.cpp:571-634: CONFIRMs carrying different [nPrepared,
+        commit, nP] ranges — p rises to the min over the quorum and the
+        externalized commit range is the intersection [3,4]."""
+        n = Core5()
+        n.scp.get_slot(1).bump_state(X, force=True)
+        n.recv_quorum(lambda: prepare_st(n.qs_hash, SCPBallot(1, X)))
+        n.recv_quorum(
+            lambda: prepare_st(n.qs_hash, SCPBallot(1, X), prepared=SCPBallot(1, X))
+        )
+        n.recv_quorum(
+            lambda: prepare_st(
+                n.qs_hash, SCPBallot(1, X), prepared=SCPBallot(1, X), nC=1, nP=1
+            )
+        )
+        pl = n.last_emit()
+        assert pl.type == ST.SCP_ST_CONFIRM
+        emitted = len(n.emitted)
+
+        # different ranges from the quorum (reference :600-611)
+        assert n.recv(1, confirm_st(n.qs_hash, 4, SCPBallot(2, X), 4)) == EnvelopeState.VALID
+        assert n.recv(2, confirm_st(n.qs_hash, 6, SCPBallot(2, X), 6)) == EnvelopeState.VALID
+        assert len(n.emitted) == emitted
+
+        # third raises p to 5: all nodes commit x
+        assert n.recv(3, confirm_st(n.qs_hash, 5, SCPBallot(3, X), 5)) == EnvelopeState.VALID
+        pl = n.last_emit()
+        assert pl.type == ST.SCP_ST_CONFIRM
+        assert pl.confirm.nPrepared == 5
+        assert pl.confirm.commit == SCPBallot(1, X)
+        assert pl.confirm.nP == 1
+
+        # fourth externalizes with range [3,4]
+        assert n.recv(4, confirm_st(n.qs_hash, 6, SCPBallot(3, X), 6)) == EnvelopeState.VALID
+        pl = n.last_emit()
+        assert pl.type == ST.SCP_ST_EXTERNALIZE
+        assert pl.externalize.commit == SCPBallot(3, X)
+        assert pl.externalize.nP == 4
+        assert n.driver.externalized == {1: X}
+
+    @pytest.mark.parametrize(
+        "a,b,expected",
+        [
+            (X, Y, SCPBallot(1, Y)),  # x<y: prepare (1,x), prepared (1,y)
+            (X, Y, SCPBallot(2, Y)),  # x<y: prepare (1,x), prepared (2,y)
+            (Y, X, SCPBallot(2, X)),  # x<y: prepare (1,y), prepared (2,x)
+        ],
+        ids=["switch-value", "bump-counter", "bump-counter-lower-value"],
+    )
+    def test_prepare_a_then_prepared_b_by_vblocking(self, a, b, expected):
+        """SCPTests.cpp:635-686: v0 prepares (1,a); a v-blocking set that
+        accepted ``expected`` prepared pulls v0's prepared up to it."""
+        n = Core5()
+        assert n.scp.get_slot(1).bump_state(a, force=True)
+        assert len(n.emitted) == 1
+        pl = n.last_emit()
+        assert pl.prepare.ballot == SCPBallot(1, a)
+
+        assert (
+            n.recv(1, prepare_st(n.qs_hash, expected, prepared=expected))
+            == EnvelopeState.VALID
+        )
+        assert len(n.emitted) == 1  # one node is not v-blocking
+
+        assert (
+            n.recv(2, prepare_st(n.qs_hash, expected, prepared=expected))
+            == EnvelopeState.VALID
+        )
+        assert len(n.emitted) == 2
+        pl = n.last_emit()
+        assert pl.prepare.prepared == expected
