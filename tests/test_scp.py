@@ -661,3 +661,165 @@ class TestBallotProtocolPorted:
         assert len(n.emitted) == 2
         pl = n.last_emit()
         assert pl.prepare.prepared == expected
+
+
+class TestBallotProtocolPorted2:
+    """Second batch ported from the reference core5 suite
+    (/root/reference/src/scp/SCPTests.cpp:687-800)."""
+
+    def test_pristine_prepared_by_vblocking(self):
+        """:691-702: two nodes accepting (1,x) prepared is v-blocking even
+        on a pristine slot — one emission, prepared follows."""
+        n = Core5()
+        b = SCPBallot(1, X)
+        assert n.recv(1, prepare_st(n.qs_hash, b, prepared=b)) == EnvelopeState.VALID
+        assert n.emitted == []
+        assert n.recv(2, prepare_st(n.qs_hash, b, prepared=b)) == EnvelopeState.VALID
+        assert len(n.emitted) == 1
+        pl = n.last_emit()
+        assert pl.type == ST.SCP_ST_PREPARE
+        assert pl.prepare.ballot == b and pl.prepare.prepared == b
+
+    def test_pristine_prepared_by_quorum(self):
+        """:703-719: four plain prepare votes form a quorum (with v0
+        implicit) — one emission with prepared set."""
+        n = Core5()
+        b = SCPBallot(1, X)
+        for i in (1, 2, 3):
+            assert n.recv(i, prepare_st(n.qs_hash, b)) == EnvelopeState.VALID
+        assert n.emitted == []
+        assert n.recv(4, prepare_st(n.qs_hash, b)) == EnvelopeState.VALID
+        assert len(n.emitted) == 1
+        pl = n.last_emit()
+        assert pl.prepare.ballot == b and pl.prepare.prepared == b
+
+    @pytest.mark.parametrize(
+        "a,expected,shouldswitch",
+        [
+            (X, SCPBallot(1, Y), False),  # same counter: no abandon
+            (X, SCPBallot(2, Y), True),   # higher counter: abandon to (2,a)
+        ],
+        ids=["same-counter", "higher-counter-switch"],
+    )
+    def test_prepare_a_prepared_b_by_quorum(self, a, expected, shouldswitch):
+        """:720-799: quorum voting a different ballot; with a higher
+        counter v0 first abandons its ballot to (2,a), then the full
+        quorum pulls prepared up to the expected ballot."""
+        n = Core5()
+        assert n.scp.get_slot(1).bump_state(a, force=True)
+        assert len(n.emitted) == 1
+        assert n.last_emit().prepare.ballot == SCPBallot(1, a)
+
+        prep_offset = 1
+        assert n.recv(1, prepare_st(n.qs_hash, expected)) == EnvelopeState.VALID
+        assert len(n.emitted) == prep_offset
+        assert n.driver.heard == []
+
+        assert n.recv(2, prepare_st(n.qs_hash, expected)) == EnvelopeState.VALID
+        if shouldswitch:
+            # the second prepare abandons the current ballot to (2,a)
+            assert len(n.emitted) == prep_offset + 1
+            assert n.last_emit().prepare.ballot == SCPBallot(2, a)
+            prep_offset += 1
+        else:
+            assert len(n.emitted) == prep_offset
+
+        assert n.recv(3, prepare_st(n.qs_hash, expected)) == EnvelopeState.VALID
+        assert len(n.emitted) == prep_offset
+        assert len(n.driver.heard) == 1  # 4 nodes present: quorum heard
+
+        assert n.recv(4, prepare_st(n.qs_hash, expected)) == EnvelopeState.VALID
+        assert len(n.driver.heard) == 2  # quorum changed its mind
+        assert len(n.emitted) == prep_offset + 1
+        pl = n.last_emit()
+        assert pl.prepare.ballot == expected
+        assert pl.prepare.prepared == expected
+
+
+class TestBallotProtocolPorted3:
+    """Third batch from the reference core5 suite
+    (/root/reference/src/scp/SCPTests.cpp:960-1210)."""
+
+    @pytest.mark.parametrize(
+        "a,b",
+        [(X, Y), (Y, X)],
+        ids=["commit-higher-value", "commit-lower-value"],
+    )
+    def test_prepared_a_accept_commit_by_vblocking_b(self, a, b):
+        """:960-1026: v0 prepared (1,a); a v-blocking pair CONFIRMing
+        commit (2,b) makes v0 accept that commit and emit CONFIRM with the
+        v-blocking set's exact range — no quorum ever heard."""
+        n = Core5()
+        expected = SCPBallot(2, b)
+        assert n.scp.get_slot(1).bump_state(a, force=True)
+        src = SCPBallot(1, a)
+        # v-blocking moves v0 to prepared (1,a)
+        n.recv_vblocking(
+            lambda: prepare_st(n.qs_hash, src, prepared=src, nC=1, nP=1)
+        )
+        assert len(n.emitted) == 2
+        assert n.last_emit().prepare.prepared == src
+
+        assert (
+            n.recv(1, confirm_st(n.qs_hash, expected.counter, expected,
+                                 expected.counter))
+            == EnvelopeState.VALID
+        )
+        assert len(n.emitted) == 2
+        assert n.driver.heard == []
+        assert (
+            n.recv(2, confirm_st(n.qs_hash, expected.counter, expected,
+                                 expected.counter))
+            == EnvelopeState.VALID
+        )
+        assert len(n.emitted) == 3
+        pl = n.last_emit()
+        assert pl.type == ST.SCP_ST_CONFIRM
+        assert pl.confirm.nPrepared == expected.counter
+        assert pl.confirm.commit == expected
+        assert pl.confirm.nP == expected.counter
+        assert n.driver.heard == []
+
+    def test_prepare_1y_receives_accept_commit_1x(self):
+        """:1167-1209: v0 prepares (1,y) while the rest commit (1,x); v0's
+        prepared is pulled to (1,x) but c stays 0 (b=(1,y) disagrees),
+        then the quorum's accepted commit flips v0 straight to CONFIRM."""
+        n = Core5()
+        assert n.scp.get_slot(1).bump_state(Y, force=True)
+        assert len(n.emitted) == 1
+        assert n.last_emit().prepare.ballot == SCPBallot(1, Y)
+
+        exp = SCPBallot(1, X)
+        st = lambda: prepare_st(n.qs_hash, exp, prepared=exp, nC=1, nP=1)
+        assert n.recv(1, st()) == EnvelopeState.VALID
+        assert len(n.emitted) == 1
+        assert n.recv(2, st()) == EnvelopeState.VALID
+        assert len(n.emitted) == 2  # v-blocking -> prepared (1,x)
+        pl = n.last_emit()
+        assert pl.prepare.ballot == SCPBallot(1, Y)
+        assert pl.prepare.prepared == exp
+
+        assert n.recv(3, st()) == EnvelopeState.VALID
+        assert len(n.emitted) == 3  # quorum confirms prepared: P=1, c stays 0
+        pl = n.last_emit()
+        assert pl.prepare.ballot == SCPBallot(1, Y)
+        assert pl.prepare.prepared == exp
+        assert pl.prepare.nC == 0 and pl.prepare.nP == 1
+
+        assert n.recv(4, st()) == EnvelopeState.VALID
+        assert len(n.emitted) == 4  # quorum accepts commit -> CONFIRM
+        pl = n.last_emit()
+        assert pl.type == ST.SCP_ST_CONFIRM
+        assert pl.confirm.nPrepared == 1
+        assert pl.confirm.commit == exp
+        assert pl.confirm.nP == 1
+
+    def test_single_confirm_on_pristine_slot_no_bump(self):
+        """:1218-1228: one CONFIRM is not v-blocking — nothing emitted."""
+        n = Core5()
+        b = SCPBallot(1, Y)
+        assert (
+            n.recv(1, confirm_st(n.qs_hash, b.counter, b, b.counter))
+            == EnvelopeState.VALID
+        )
+        assert n.emitted == []
