@@ -823,3 +823,63 @@ class TestBallotProtocolPorted3:
             == EnvelopeState.VALID
         )
         assert n.emitted == []
+
+
+Z = b"\x03" * 32  # X < Y < Z
+
+
+class TestBallotProtocolPorted4:
+    """Fourth batch from the reference core5 suite
+    (/root/reference/src/scp/SCPTests.cpp:1269-1356)."""
+
+    def test_prepared_prime_rotates_through_values(self):
+        """:1269-1327: successive v-blocking switches x -> y -> z; prepared'
+        always trails with the previous prepared ballot."""
+        n = Core5()
+        bx, by, bz = SCPBallot(1, X), SCPBallot(2, Y), SCPBallot(3, Z)
+        assert n.scp.get_slot(1).bump_state(X, force=True)
+        assert len(n.emitted) == 1
+
+        n.recv_vblocking(lambda: prepare_st(n.qs_hash, bx, prepared=bx, nC=1, nP=1))
+        assert len(n.emitted) == 2
+        pl = n.last_emit()
+        assert pl.prepare.ballot == bx and pl.prepare.prepared == bx
+
+        n.recv_vblocking(lambda: prepare_st(n.qs_hash, by, prepared=by, nC=2, nP=2))
+        assert len(n.emitted) == 3
+        pl = n.last_emit()
+        assert pl.prepare.ballot == by and pl.prepare.prepared == by
+        assert pl.prepare.preparedPrime == bx
+        assert pl.prepare.nC == 0 and pl.prepare.nP == 0
+
+        n.recv_vblocking(lambda: prepare_st(n.qs_hash, bz, prepared=bz, nC=3, nP=3))
+        assert len(n.emitted) == 4
+        pl = n.last_emit()
+        assert pl.prepare.ballot == bz and pl.prepare.prepared == bz
+        assert pl.prepare.preparedPrime == by
+
+    def test_timeout_with_p_set_stays_locked_on_value(self):
+        """:1328-1356: once P (confirmed prepared) is set on x, a timeout
+        bump to y must stay locked on x — only the counter moves."""
+        n = Core5()
+        bx = SCPBallot(1, X)
+        assert n.scp.get_slot(1).bump_state(X, force=True)
+        assert len(n.emitted) == 1
+
+        n.recv_vblocking(lambda: prepare_st(n.qs_hash, bx, prepared=bx))
+        assert len(n.emitted) == 2
+        pl = n.last_emit()
+        assert pl.prepare.ballot == bx and pl.prepare.prepared == bx
+
+        assert n.recv(3, prepare_st(n.qs_hash, bx, prepared=bx)) == EnvelopeState.VALID
+        assert len(n.emitted) == 3  # quorum: confirmed prepared, c=P=1
+        pl = n.last_emit()
+        assert pl.prepare.nC == 1 and pl.prepare.nP == 1
+
+        # timeout bump towards y: value stays x, counter bumps to 2
+        assert n.scp.get_slot(1).bump_state(Y, force=True)
+        assert len(n.emitted) == 4
+        pl = n.last_emit()
+        assert pl.prepare.ballot == SCPBallot(2, X)
+        assert pl.prepare.prepared == bx
+        assert pl.prepare.nC == 1 and pl.prepare.nP == 1
