@@ -883,3 +883,60 @@ class TestBallotProtocolPorted4:
         assert pl.prepare.ballot == SCPBallot(2, X)
         assert pl.prepare.prepared == bx
         assert pl.prepare.nC == 1 and pl.prepare.nP == 1
+
+
+class _V0TopDriver(ScriptedDriver):
+    """The reference's mPriorityLookup: v0 always wins the leader lottery
+    (SCPTests.cpp:1509 'nomination - v0 is top')."""
+
+    def compute_hash_node(self, slot_index, prev, is_priority, round_number, node_id):
+        return 1000 if node_id == NODES[0] else 1
+
+
+class TestNominationPorted:
+    """Self-nominates x, others nominate y (SCPTests.cpp:1673-1759)."""
+
+    def _run(self, accept_via_quorum: bool):
+        n = Core5()
+        n.driver = _V0TopDriver([n.qset])
+        n.scp = SCP(n.driver, NODES[0], True, n.qset)
+        n.driver.expected_candidates = {X}
+        n.driver.composite = X
+        assert n.scp.nominate(1, X, previous_value=b"\x00" * 32)
+        assert len(n.emitted) == 1
+        pl = n.last_emit()
+        assert pl.nominate.votes == [X] and pl.nominate.accepted == []
+
+        if accept_via_quorum:
+            # quorum all voting y forces v0 to accept y
+            for i in (1, 2, 3):
+                n.recv(i, nominate_st(n.qs_hash, votes=[Y], accepted=[]))
+            assert len(n.emitted) == 1
+            n.recv(4, nominate_st(n.qs_hash, votes=[Y], accepted=[]))
+        else:
+            # a v-blocking pair that ACCEPTED y forces v0 to accept y
+            n.recv(1, nominate_st(n.qs_hash, votes=[Y], accepted=[Y]))
+            assert len(n.emitted) == 1
+            n.recv(2, nominate_st(n.qs_hash, votes=[Y], accepted=[Y]))
+        assert len(n.emitted) == 2
+        pl = n.last_emit()
+        assert pl.nominate.votes == sorted([X, Y])
+        assert pl.nominate.accepted == [Y]
+
+        # quorum accepting y promotes it to candidate -> ballot on y
+        n.driver.expected_candidates = {Y}
+        n.driver.composite = Y
+        got_prepare = False
+        for i in (1, 2, 3, 4):
+            n.recv(i, nominate_st(n.qs_hash, votes=[Y], accepted=[Y]))
+            if n.last_emit().type == ST.SCP_ST_PREPARE:
+                got_prepare = True
+                break
+        assert got_prepare
+        assert n.last_emit().prepare.ballot == SCPBallot(1, Y)
+
+    def test_accept_via_quorum(self):
+        self._run(accept_via_quorum=True)
+
+    def test_accept_via_vblocking(self):
+        self._run(accept_via_quorum=False)
