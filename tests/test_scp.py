@@ -940,3 +940,20 @@ class TestNominationPorted:
 
     def test_accept_via_vblocking(self):
         self._run(accept_via_quorum=False)
+
+
+def test_restore_externalize_state():
+    """SCPTests.cpp:1479-1482: a node restarted from its own EXTERNALIZE
+    statement resumes in the EXTERNALIZE phase and keeps answering."""
+    n = TestBallotProtocolPorted._externalized_node()
+    saved = n.scp.get_latest_messages_send(1)
+    assert saved and saved[-1].statement.pledges.type == ST.SCP_ST_EXTERNALIZE
+
+    n2 = Core5()
+    for e in saved:
+        n2.scp.set_state_from_envelope(1, e)
+    assert n2.bp().phase == Phase.EXTERNALIZE
+    assert n2.bp().commit == SCPBallot(1, X)
+    # the restored node re-serves its externalize statement
+    out = n2.scp.get_latest_messages_send(1)
+    assert out and out[-1].statement.pledges.type == ST.SCP_ST_EXTERNALIZE
