@@ -64,6 +64,8 @@ class ProcessManager:
         log.debug("running: %s", ev.cmdline)
 
         def work():
+            if self._shutdown:
+                return -15  # shutdown raced the spawn: never start the child
             try:
                 proc = subprocess.Popen(
                     ev.cmdline,
@@ -75,6 +77,13 @@ class ProcessManager:
                 log.warning("spawn failed for %r: %s", ev.cmdline, e)
                 return 127
             self._live_procs.add(proc)
+            if self._shutdown:
+                # shutdown() ran between the check above and the spawn —
+                # it cannot have seen this proc in _live_procs, so kill here
+                try:
+                    proc.terminate()
+                except OSError:
+                    pass
             try:
                 return proc.wait()
             finally:
