@@ -138,3 +138,21 @@ def test_autoload_calibration():
     finally:
         app.graceful_stop()
         clock.shutdown()
+
+
+def test_tcp_consensus_under_load():
+    """3 validators over real TCP sockets externalize 15+ ledgers while a
+    LoadGenerator streams create-account + payment traffic through one of
+    them — consensus, flooding, and apply under concurrent load."""
+    from stellar_tpu.simulation.loadgen import LoadGenerator
+
+    sim = topologies.core(3, mode=OVER_TCP)
+    sim.start_all_nodes()
+    assert sim.crank_until(lambda: sim.have_all_externalized(5), 240)
+
+    gen = LoadGenerator()
+    gen.generate_load(next(iter(sim.nodes.values())), 10, 40, 20)
+    assert sim.crank_until(gen.is_done, 600)
+    assert sim.crank_until(lambda: sim.have_all_externalized(15), 300)
+    assert sim.all_ledgers_agree()
+    sim.stop_all_nodes()
