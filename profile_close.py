@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""Profile the steady-state ledger close on the CPU sig backend.
+
+Not part of the test suite — a developer tool for attacking the
+ledger-close p50 (BASELINE.md second headline metric).  Usage:
+
+    python profile_close.py [n_txs] [n_ledgers]
+"""
+
+import cProfile
+import io
+import pstats
+import statistics
+import sys
+import time
+
+
+def main(n_txs=1000, n_ledgers=3):
+    from stellar_tpu.herder.ledgerclose import LedgerCloseData
+    from stellar_tpu.herder.txset import TxSetFrame
+    from stellar_tpu.ledger.accountframe import AccountFrame
+    from stellar_tpu.main.application import Application
+    from stellar_tpu.tx import testutils as T
+    from stellar_tpu.util.clock import VirtualClock
+    from stellar_tpu.xdr.base import xdr_to_opaque
+    from stellar_tpu.xdr.ledger import (
+        LedgerUpgrade,
+        LedgerUpgradeType,
+        StellarValue,
+    )
+
+    cfg = T.get_test_config(96, backend="cpu")
+    cfg.DESIRED_MAX_TX_PER_LEDGER = n_txs * 2
+    clock = VirtualClock()
+    app = Application.create(clock, cfg, new_db=True)
+    try:
+        lm = app.ledger_manager
+        root = T.root_key_for(app)
+        up = xdr_to_opaque(
+            LedgerUpgrade(
+                LedgerUpgradeType.LEDGER_UPGRADE_MAX_TX_SET_SIZE, n_txs * 2
+            )
+        )
+        upgrades = [up]
+        accounts = [T.get_account(i + 1) for i in range(n_txs + 1)]
+        seq = AccountFrame.load_account(
+            root.get_public_key(), app.database
+        ).get_seq_num()
+        created_at = {}
+        for start in range(0, len(accounts), 2000):
+            batch = accounts[start : start + 2000]
+            txs = []
+            for i in range(0, len(batch), 100):
+                seq += 1
+                txs.append(
+                    T.tx_from_ops(
+                        app,
+                        root,
+                        seq,
+                        [
+                            T.create_account_op(a, 10**10)
+                            for a in batch[i : i + 100]
+                        ],
+                    )
+                )
+            txset = TxSetFrame(lm.last_closed.hash, txs)
+            txset.sort_for_hash()
+            assert txset.check_valid(app)
+            sv = StellarValue(
+                txset.get_contents_hash(),
+                lm.last_closed.header.scpValue.closeTime + 5,
+                upgrades,
+                0,
+            )
+            upgrades = []
+            lm.close_ledger(
+                LedgerCloseData(lm.current.header.ledgerSeq, txset, sv)
+            )
+            for a in batch:
+                created_at[a.get_strkey_public()] = (
+                    lm.last_closed.header.ledgerSeq
+                )
+
+        pr = cProfile.Profile()
+        times = []
+        for j in range(n_ledgers):
+            txs = []
+            for i in range(n_txs):
+                src = accounts[i]
+                dst = accounts[i + 1]
+                s = (created_at[src.get_strkey_public()] << 32) + 1 + j
+                txs.append(
+                    T.tx_from_ops(app, src, s, [T.payment_op(dst, 1000)])
+                )
+            txset = TxSetFrame(lm.last_closed.hash, txs)
+            txset.sort_for_hash()
+            t0 = time.perf_counter()
+            pr.enable()
+            ok = txset.check_valid(app)
+            sv = StellarValue(
+                txset.get_contents_hash(),
+                lm.last_closed.header.scpValue.closeTime + 5,
+                [],
+                0,
+            )
+            lm.close_ledger(
+                LedgerCloseData(lm.current.header.ledgerSeq, txset, sv)
+            )
+            pr.disable()
+            times.append(time.perf_counter() - t0)
+            assert ok
+        print(
+            f"p50 {statistics.median(times) * 1e3:.0f} ms over {n_ledgers} "
+            f"closes of {n_txs} txs"
+        )
+        for sort in ("cumulative", "tottime"):
+            s = io.StringIO()
+            pstats.Stats(pr, stream=s).sort_stats(sort).print_stats(30)
+            body = s.getvalue()
+            # drop the boilerplate header lines
+            print("\n".join(body.splitlines()[:40]))
+    finally:
+        app.graceful_stop()
+        clock.shutdown()
+
+
+if __name__ == "__main__":
+    main(
+        int(sys.argv[1]) if len(sys.argv) > 1 else 1000,
+        int(sys.argv[2]) if len(sys.argv) > 2 else 3,
+    )

@@ -88,17 +88,15 @@ class TxSetFrame:
             batches[v].append(tx)
             seen_count[tx.get_source_id().value] = v + 1
 
-        set_hash = self.get_contents_hash()
-        import functools
-
-        cmp = functools.cmp_to_key(
-            lambda t1, t2: -1
-            if less_than_xored(t1.get_full_hash(), t2.get_full_hash(), set_hash)
-            else 1
-        )
+        # lessThanXored(l, r, x) is a lexicographic compare of l^x vs r^x,
+        # which equals comparing the big-endian integers (l^x) < (r^x) —
+        # so a key sort, not a comparator sort
+        xh = int.from_bytes(self.get_contents_hash(), "big")
         out: List[TransactionFrame] = []
         for batch in batches:
-            batch.sort(key=cmp)
+            batch.sort(
+                key=lambda tx: int.from_bytes(tx.get_full_hash(), "big") ^ xh
+            )
             out.extend(batch)
         return out
 

@@ -32,17 +32,19 @@ class LedgerDelta:
             self._outer = outer
             self._db = outer._db
             self._header_target = None
-            self.header = _copy_header(outer.header)
-            self._previous_header = outer.header
+            self._previous_header = outer.header_ro()
             self.update_last_modified = outer.update_last_modified
         else:
             assert header is not None and db is not None
             self._outer = None
             self._db = db
             self._header_target = header  # committed back on commit()
-            self.header = _copy_header(header)
             self._previous_header = header
             self.update_last_modified = update_last_modified
+        # header copy is lazy: most nested deltas (one per applied tx/op)
+        # only ever *read* ledgerSeq, so the private mutable copy is made
+        # on first `header` access, not per delta
+        self._header_local = None
         # key-xdr -> LedgerEntry (copies)
         self._new: Dict[bytes, LedgerEntry] = {}
         self._mod: Dict[bytes, LedgerEntry] = {}
@@ -51,6 +53,18 @@ class LedgerDelta:
         self._open = True
 
     # -- header ------------------------------------------------------------
+    @property
+    def header(self):
+        """Mutable view — private copy made on first access."""
+        if self._header_local is None:
+            self._header_local = _copy_header(self._previous_header)
+        return self._header_local
+
+    def header_ro(self):
+        """Read-only view; callers must not mutate the returned object."""
+        h = self._header_local
+        return h if h is not None else self._previous_header
+
     def get_header(self):
         return self.header
 
@@ -120,9 +134,12 @@ class LedgerDelta:
                 else:
                     out._mod.pop(kb, None)
                     out._delete.add(kb)
-            out.header = _copy_header(self.header)
-        else:
-            _assign_header(self._header_target, self.header)
+            if self._header_local is not None:
+                # transfer ownership — this delta is closed and will not
+                # touch the object again
+                out._header_local = self._header_local
+        elif self._header_local is not None:
+            _assign_header(self._header_target, self._header_local)
 
     def rollback(self) -> None:
         """Discard changes; flush entry cache for touched keys (the SQL
@@ -190,10 +207,10 @@ def _copy_entry(e: LedgerEntry) -> LedgerEntry:
 
 
 def _copy_header(h):
-    """Codec-driven copy — called ~9x per applied transaction (one per
-    nested delta), where an XDR serialization round-trip was ~25% of
-    ledger-close time.  xdr_copy stays in sync with the LedgerHeader
-    field list automatically."""
+    """Codec-driven copy, made lazily on first mutable `header` access —
+    a payment tx's nested deltas never touch the header, so the common
+    case is zero copies per tx (an eager copy per nested delta was ~8
+    copies/tx and a measurable slice of ledger-close time)."""
     return xdr_copy(h)
 
 

@@ -112,7 +112,7 @@ class EntryFrame:
     # -- shared plumbing ---------------------------------------------------
     def _stamp(self, delta) -> None:
         if delta.update_last_modified:
-            self.last_modified = delta.get_header().ledgerSeq
+            self.last_modified = delta.header_ro().ledgerSeq
 
     @staticmethod
     def cache_of(db) -> EntryCache:

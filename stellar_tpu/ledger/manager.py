@@ -284,21 +284,26 @@ class LedgerManager:
         self.app.bucket_manager.forget_unreferenced_buckets()
 
     def _process_fees_seq_nums(self, txs, delta) -> None:
+        from ..tx import history as tx_history
+
+        rows = []
+        seq = self.current.header.ledgerSeq
         with self.database.transaction():
             for index, tx in enumerate(txs, start=1):
                 this_tx_delta = LedgerDelta(outer=delta)
                 tx.process_fee_seq_num(this_tx_delta, self)
-                tx.store_transaction_fee(
-                    self.database,
-                    self.current.header.ledgerSeq,
-                    index,
-                    this_tx_delta.get_changes(),
+                rows.append(
+                    tx.fee_history_row(seq, index, this_tx_delta.get_changes())
                 )
                 this_tx_delta.commit()
+            tx_history.insert_fee_rows(self.database, rows)
 
     def _apply_transactions(self, txs, ledger_delta, tx_result_set) -> None:
+        from ..tx import history as tx_history
         from ..xdr.txs import TransactionResultCode
 
+        rows = []
+        seq = self.current.header.ledgerSeq
         for index, tx in enumerate(txs, start=1):
             with self._tx_apply_timer.time_scope():
                 delta = LedgerDelta(outer=ledger_delta)
@@ -313,9 +318,8 @@ class LedgerManager:
                     tx.set_result_code(TransactionResultCode.txINTERNAL_ERROR)
             self._tx_count_meter.mark()
             tx_result_set.results.append(tx.get_result_pair())
-            tx.store_transaction(
-                self.database, self.current.header.ledgerSeq, index, meta
-            )
+            rows.append(tx.history_row(seq, index, meta))
+        tx_history.insert_transaction_rows(self.database, rows)
 
     def _close_ledger_helper(self, delta) -> None:
         """BucketList add + header store + LCL pointers

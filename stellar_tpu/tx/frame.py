@@ -343,9 +343,9 @@ class TransactionFrame:
         return not error_encountered
 
     # -- persistence (txhistory / txfeehistory) ----------------------------
-    def store_transaction(self, db, ledger_seq: int, tx_index: int, meta) -> None:
-        tx_history.store_transaction(
-            db,
+    def history_row(self, ledger_seq: int, tx_index: int, meta):
+        """Row tuple for the bulk txhistory insert at ledger close."""
+        return tx_history.transaction_row(
             self.get_contents_hash(),
             ledger_seq,
             tx_index,
@@ -354,9 +354,9 @@ class TransactionFrame:
             meta,
         )
 
-    def store_transaction_fee(self, db, ledger_seq: int, tx_index: int, changes) -> None:
-        tx_history.store_transaction_fee(
-            db, self.get_contents_hash(), ledger_seq, tx_index, changes
+    def fee_history_row(self, ledger_seq: int, tx_index: int, changes):
+        return tx_history.fee_row(
+            self.get_contents_hash(), ledger_seq, tx_index, changes
         )
 
     def to_stellar_message(self) -> StellarMessage:

@@ -47,42 +47,52 @@ def drop_tx_history(db) -> None:
     db.execute("CREATE INDEX histfeebyseq ON txfeehistory (ledgerseq)")
 
 
-def store_transaction(
-    db,
+def transaction_row(
     tx_id: bytes,
     ledger_seq: int,
     tx_index: int,
     envelope: TransactionEnvelope,
     result_pair: TransactionResultPair,
     meta: TransactionMeta,
-) -> None:
-    db.execute(
-        "INSERT INTO txhistory (txid, ledgerseq, txindex, txbody, txresult, txmeta)"
-        " VALUES (?,?,?,?,?,?)",
-        (
-            tx_id.hex(),
-            ledger_seq,
-            tx_index,
-            base64.b64encode(envelope.to_xdr()).decode(),
-            base64.b64encode(result_pair.to_xdr()).decode(),
-            base64.b64encode(meta.to_xdr()).decode(),
-        ),
+) -> Tuple:
+    return (
+        tx_id.hex(),
+        ledger_seq,
+        tx_index,
+        base64.b64encode(envelope.to_xdr()).decode(),
+        base64.b64encode(result_pair.to_xdr()).decode(),
+        base64.b64encode(meta.to_xdr()).decode(),
     )
 
 
-def store_transaction_fee(
-    db, tx_id: bytes, ledger_seq: int, tx_index: int, changes
-) -> None:
-    db.execute(
-        "INSERT INTO txfeehistory (txid, ledgerseq, txindex, txchanges)"
-        " VALUES (?,?,?,?)",
-        (
-            tx_id.hex(),
-            ledger_seq,
-            tx_index,
-            base64.b64encode(LEDGER_ENTRY_CHANGES.pack(changes)).decode(),
-        ),
+def fee_row(tx_id: bytes, ledger_seq: int, tx_index: int, changes) -> Tuple:
+    return (
+        tx_id.hex(),
+        ledger_seq,
+        tx_index,
+        base64.b64encode(LEDGER_ENTRY_CHANGES.pack(changes)).decode(),
     )
+
+
+_TX_INSERT = (
+    "INSERT INTO txhistory (txid, ledgerseq, txindex, txbody, txresult, txmeta)"
+    " VALUES (?,?,?,?,?,?)"
+)
+_FEE_INSERT = (
+    "INSERT INTO txfeehistory (txid, ledgerseq, txindex, txchanges)"
+    " VALUES (?,?,?,?)"
+)
+
+
+def insert_transaction_rows(db, rows: List[Tuple]) -> None:
+    """Bulk path for ledger close: one executemany for the whole txset."""
+    if rows:
+        db.executemany(_TX_INSERT, rows)
+
+
+def insert_fee_rows(db, rows: List[Tuple]) -> None:
+    if rows:
+        db.executemany(_FEE_INSERT, rows)
 
 
 def load_transaction_history(db, ledger_seq: int) -> List[Tuple]:

@@ -105,7 +105,7 @@ class CreateAccountOpFrame(OperationFrame):
         self.source_account.store_change(delta, db)
         dest = AccountFrame(account_id=self.ca.destination)
         # new accounts start at (currentLedgerSeq << 32)
-        dest.account.seqNum = delta.get_header().ledgerSeq << 32
+        dest.account.seqNum = delta.header_ro().ledgerSeq << 32
         dest.account.balance = self.ca.startingBalance
         dest.store_add(delta, db)
         metrics.new_meter(("op-create-account", "success", "apply"), "operation").mark()
