@@ -52,7 +52,7 @@ class TxSetFrame:
             h = SHA256()
             h.add(self.previous_ledger_hash)
             for tx in self.transactions:
-                h.add(tx.envelope.to_xdr())
+                h.add(tx.env_xdr())
             self._hash = h.finish()
         return self._hash
 

@@ -185,7 +185,7 @@ class AccountFrame(EntryFrame):
         key._kb = kb
         hit, cached = cls.cache_of(db).get(kb)
         if hit:
-            return cls(LedgerEntry.from_xdr(cached)) if cached else None
+            return cls(cached) if cached else None
         aid = _aid(account_id)
         with db.timed("select", "account"):
             row = db.query_one(
@@ -272,18 +272,6 @@ class AccountFrame(EntryFrame):
                 "INSERT INTO signers (accountid, publickey, weight) VALUES (?,?,?)",
                 [(aid, _aid(s.pubKey), s.weight) for s in a.signers],
             )
-
-    def store_add(self, delta, db) -> None:
-        self._stamp(delta)
-        self._persist(db, insert=True)
-        delta.add_entry(self)
-        self.store_in_cache(db, self.get_key(), self.entry)
-
-    def store_change(self, delta, db) -> None:
-        self._stamp(delta)
-        self._persist(db, insert=False)
-        delta.mod_entry(self)
-        self.store_in_cache(db, self.get_key(), self.entry)
 
     def store_delete(self, delta, db) -> None:
         aid = _aid(self.account.accountID)

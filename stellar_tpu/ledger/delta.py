@@ -79,22 +79,33 @@ class LedgerDelta:
         return kb
 
     def add_entry(self, frame) -> None:
-        kb = self._remember_key(frame.get_key())
+        self.add_entry_snapshot(frame.get_key(), _copy_entry(frame.entry))
+
+    def add_entry_snapshot(self, key: LedgerKey, entry: LedgerEntry) -> None:
+        """Record a created entry, taking ownership of `entry` (the caller
+        must not mutate it afterwards — it may be shared with the entry
+        cache as an immutable snapshot)."""
+        kb = self._remember_key(key)
         if kb in self._delete:
             # deleted-then-recreated == modified
             self._delete.discard(kb)
-            self._mod[kb] = _copy_entry(frame.entry)
+            self._mod[kb] = entry
         else:
             assert kb not in self._new and kb not in self._mod, "double create"
-            self._new[kb] = _copy_entry(frame.entry)
+            self._new[kb] = entry
 
     def mod_entry(self, frame) -> None:
-        kb = self._remember_key(frame.get_key())
+        self.mod_entry_snapshot(frame.get_key(), _copy_entry(frame.entry))
+
+    def mod_entry_snapshot(self, key: LedgerKey, entry: LedgerEntry) -> None:
+        """Record a modified entry, taking ownership of `entry` (see
+        add_entry_snapshot)."""
+        kb = self._remember_key(key)
         if kb in self._new:
-            self._new[kb] = _copy_entry(frame.entry)
+            self._new[kb] = entry
         else:
             assert kb not in self._delete, "modifying deleted entry"
-            self._mod[kb] = _copy_entry(frame.entry)
+            self._mod[kb] = entry
 
     def delete_entry_frame(self, frame) -> None:
         self.delete_entry(frame.get_key())

@@ -139,7 +139,7 @@ class OfferFrame(EntryFrame):
         key = LedgerKey(LedgerEntryType.OFFER, LedgerKeyOffer(seller, offer_id))
         hit, cached = cls.cache_of(db).get(key.to_xdr())
         if hit:
-            return cls(LedgerEntry.from_xdr(cached)) if cached else None
+            return cls(cached) if cached else None
         with db.timed("select", "offer"):
             row = db.query_one(
                 f"SELECT {cls._COLS} FROM offers WHERE sellerid=? AND offerid=?",
@@ -234,18 +234,6 @@ class OfferFrame(EntryFrame):
                         o.offerID,
                     ),
                 )
-
-    def store_add(self, delta, db) -> None:
-        self._stamp(delta)
-        self._persist(db, insert=True)
-        delta.add_entry(self)
-        self.store_in_cache(db, self.get_key(), self.entry)
-
-    def store_change(self, delta, db) -> None:
-        self._stamp(delta)
-        self._persist(db, insert=False)
-        delta.mod_entry(self)
-        self.store_in_cache(db, self.get_key(), self.entry)
 
     def store_delete(self, delta, db) -> None:
         with db.timed("delete", "offer"):

@@ -155,7 +155,7 @@ class TrustFrame(EntryFrame):
         )
         hit, cached = cls.cache_of(db).get(key.to_xdr())
         if hit:
-            return cls(LedgerEntry.from_xdr(cached)) if cached else None
+            return cls(cached) if cached else None
         _, issuer, code = asset_to_cols(asset)
         with db.timed("select", "trust"):
             row = db.query_one(
@@ -232,18 +232,12 @@ class TrustFrame(EntryFrame):
 
     def store_add(self, delta, db) -> None:
         assert not self.is_issuer, "issuer frames are never persisted"
-        self._stamp(delta)
-        self._persist(db, insert=True)
-        delta.add_entry(self)
-        self.store_in_cache(db, self.get_key(), self.entry)
+        super().store_add(delta, db)
 
     def store_change(self, delta, db) -> None:
         if self.is_issuer:
             return  # synthetic line: nothing to persist
-        self._stamp(delta)
-        self._persist(db, insert=False)
-        delta.mod_entry(self)
-        self.store_in_cache(db, self.get_key(), self.entry)
+        super().store_change(delta, db)
 
     def store_delete(self, delta, db) -> None:
         assert not self.is_issuer

@@ -47,6 +47,7 @@ class TransactionFrame:
         self.envelope = envelope
         self._contents_hash: Optional[bytes] = None
         self._full_hash: Optional[bytes] = None
+        self._env_xdr: Optional[bytes] = None
         self.result: TransactionResult = TransactionResult()
         self.operations: List = []
         self.signing_account: Optional[AccountFrame] = None
@@ -62,6 +63,15 @@ class TransactionFrame:
     def clear_cached(self):
         self._contents_hash = None
         self._full_hash = None
+        self._env_xdr = None
+
+    def env_xdr(self) -> bytes:
+        """Memoized envelope encoding — the envelope is packed for the full
+        hash, the txset contents hash, and the txhistory row; it only
+        changes when a signature is added (clear_cached)."""
+        if self._env_xdr is None:
+            self._env_xdr = self.envelope.to_xdr()
+        return self._env_xdr
 
     def get_contents_hash(self) -> bytes:
         if self._contents_hash is None:
@@ -74,7 +84,7 @@ class TransactionFrame:
 
     def get_full_hash(self) -> bytes:
         if self._full_hash is None:
-            self._full_hash = sha256(self.envelope.to_xdr())
+            self._full_hash = sha256(self.env_xdr())
         return self._full_hash
 
     # -- basic accessors ---------------------------------------------------
@@ -349,7 +359,7 @@ class TransactionFrame:
             self.get_contents_hash(),
             ledger_seq,
             tx_index,
-            self.envelope,
+            self.env_xdr(),
             self.get_result_pair(),
             meta,
         )

@@ -51,7 +51,7 @@ def transaction_row(
     tx_id: bytes,
     ledger_seq: int,
     tx_index: int,
-    envelope: TransactionEnvelope,
+    envelope_xdr: bytes,
     result_pair: TransactionResultPair,
     meta: TransactionMeta,
 ) -> Tuple:
@@ -59,7 +59,7 @@ def transaction_row(
         tx_id.hex(),
         ledger_seq,
         tx_index,
-        base64.b64encode(envelope.to_xdr()).decode(),
+        base64.b64encode(envelope_xdr).decode(),
         base64.b64encode(result_pair.to_xdr()).decode(),
         base64.b64encode(meta.to_xdr()).decode(),
     )
