@@ -211,10 +211,19 @@ class TransactionFrame:
         return triples
 
     # -- account loading ---------------------------------------------------
-    def load_account(self, db, account_id: Optional[PublicKey] = None):
-        if account_id is None or account_id == self.get_source_id():
-            self.signing_account = AccountFrame.load_account(self.get_source_id(), db)
-            return self.signing_account
+    def load_account(self, db):
+        """(Re)load the tx source into signing_account."""
+        self.signing_account = AccountFrame.load_account(self.get_source_id(), db)
+        return self.signing_account
+
+    def load_account_shared(self, db, account_id: PublicKey):
+        """Reuse the already-loaded signing account when an op's source is
+        the tx source — the reference shares mSigningAccount the same way
+        (TransactionFrame::loadAccount, src/transactions/TransactionFrame.cpp),
+        so op mutations are visible through the tx frame and vice versa."""
+        sa = self.signing_account
+        if sa is not None and sa.account.accountID == account_id:
+            return sa
         return AccountFrame.load_account(account_id, db)
 
     # -- validity (TransactionFrame.cpp:215-312) ---------------------------

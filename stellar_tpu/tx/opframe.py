@@ -111,7 +111,9 @@ class OperationFrame:
         return self.parent_tx.envelope.tx.sourceAccount
 
     def load_account(self, db) -> bool:
-        self.source_account = AccountFrame.load_account(self.get_source_id(), db)
+        self.source_account = self.parent_tx.load_account_shared(
+            db, self.get_source_id()
+        )
         return self.source_account is not None
 
     # -- auth --------------------------------------------------------------

@@ -58,6 +58,10 @@ _I64 = struct.Struct(">q")
 class XdrCodec:
     """Base codec: packs values into a bytearray, unpacks from a buffer."""
 
+    # True when this codec's Python values are immutable (or declared
+    # value-semantics), so xdr_copy may share them instead of rebuilding.
+    immutable = False
+
     def pack_into(self, val: Any, out: bytearray) -> None:
         raise NotImplementedError
 
@@ -84,6 +88,7 @@ class XdrCodec:
 
 
 class _UInt32(XdrCodec):
+    immutable = True
     def pack_into(self, val, out):
         if not 0 <= val <= 0xFFFFFFFF:
             raise XdrError(f"uint32 out of range: {val}")
@@ -96,6 +101,7 @@ class _UInt32(XdrCodec):
 
 
 class _Int32(XdrCodec):
+    immutable = True
     def pack_into(self, val, out):
         if not -0x80000000 <= val <= 0x7FFFFFFF:
             raise XdrError(f"int32 out of range: {val}")
@@ -108,6 +114,7 @@ class _Int32(XdrCodec):
 
 
 class _UInt64(XdrCodec):
+    immutable = True
     def pack_into(self, val, out):
         if not 0 <= val <= 0xFFFFFFFFFFFFFFFF:
             raise XdrError(f"uint64 out of range: {val}")
@@ -120,6 +127,7 @@ class _UInt64(XdrCodec):
 
 
 class _Int64(XdrCodec):
+    immutable = True
     def pack_into(self, val, out):
         if not -0x8000000000000000 <= val <= 0x7FFFFFFFFFFFFFFF:
             raise XdrError(f"int64 out of range: {val}")
@@ -132,6 +140,7 @@ class _Int64(XdrCodec):
 
 
 class _Bool(XdrCodec):
+    immutable = True
     def pack_into(self, val, out):
         out += _U32.pack(1 if val else 0)
 
@@ -156,6 +165,8 @@ def _pad(n: int) -> int:
 class _Opaque(XdrCodec):
     """Fixed-length opaque[n]."""
 
+    immutable = True
+
     def __init__(self, n: int):
         self.n = n
 
@@ -177,6 +188,8 @@ class _Opaque(XdrCodec):
 
 class _VarOpaque(XdrCodec):
     """Variable-length opaque<max>."""
+
+    immutable = True
 
     def __init__(self, maxlen: Optional[int] = None):
         self.maxlen = maxlen if maxlen is not None else 0xFFFFFFFF
@@ -240,6 +253,8 @@ class _Array(XdrCodec):
         return vals, off
 
     def copy(self, val):
+        if self.elem.immutable:
+            return list(val)
         return [self.elem.copy(v) for v in val]
 
 
@@ -268,6 +283,8 @@ class _VarArray(XdrCodec):
         return vals, off
 
     def copy(self, val):
+        if self.elem.immutable:
+            return list(val)
         return [self.elem.copy(v) for v in val]
 
 
@@ -276,6 +293,7 @@ class _Option(XdrCodec):
 
     def __init__(self, elem: XdrCodec):
         self.elem = elem
+        self.immutable = elem.immutable
 
     def pack_into(self, val, out):
         if val is None:
@@ -295,6 +313,7 @@ class _Option(XdrCodec):
 
 
 class _Enum(XdrCodec):
+    immutable = True
     def __init__(self, enum_cls):
         self.enum_cls = enum_cls
 
@@ -422,6 +441,13 @@ class _StructCodec(XdrCodec):
                 enums.append(ecls)
         flush()
         self._plan = plan
+        # copy plan: skip codec dispatch for immutable-valued fields; a
+        # whole struct declaring XDR_VALUE_SEMANTICS (all-immutable fields,
+        # instances never mutated in place — e.g. PublicKey) is shared
+        self._copy_plan = tuple((n, c, c.immutable) for n, c in fields)
+        self.immutable = bool(
+            getattr(cls, "XDR_VALUE_SEMANTICS", False)
+        ) and all(imm for _, _, imm in self._copy_plan)
 
     def pack_into(self, val, out):
         for item in self._plan:
@@ -493,14 +519,23 @@ class _StructCodec(XdrCodec):
         return self.cls(**kw), off
 
     def copy(self, val):
+        if self.immutable:
+            return val
         return self.cls(
-            **{n: c.copy(getattr(val, n)) for n, c in self.fields}
+            *[
+                getattr(val, n) if imm else c.copy(getattr(val, n))
+                for n, c, imm in self._copy_plan
+            ]
         )
 
 
 def xstruct(cls):
-    """Decorator: dataclass + XDR codec derived from ``xf`` field metadata."""
-    cls = dataclass(cls)
+    """Decorator: dataclass + XDR codec derived from ``xf`` field metadata.
+
+    Classes declaring ``XDR_VALUE_SEMANTICS = True`` become frozen
+    dataclasses: xdr_copy shares their instances, so an accidental in-place
+    mutation must fail loudly instead of corrupting shared snapshots."""
+    cls = dataclass(cls, frozen=bool(getattr(cls, "XDR_VALUE_SEMANTICS", False)))
     fields = []
     for f in dataclasses.fields(cls):
         codec = f.metadata.get("xdr")
@@ -519,6 +554,11 @@ class _UnionCodec(XdrCodec):
         self.switch_codec = switch_codec
         self.arms = arms  # discriminant -> codec | None (void)
         self.default_void = default_void
+        # see _StructCodec: XDR_VALUE_SEMANTICS unions (e.g. PublicKey)
+        # with immutable arms are shared by xdr_copy
+        self.immutable = bool(
+            getattr(cls, "XDR_VALUE_SEMANTICS", False)
+        ) and all(c is None or c.immutable for c in arms.values())
 
     def _arm_codec(self, disc):
         try:
@@ -556,9 +596,13 @@ class _UnionCodec(XdrCodec):
         return self.cls(disc, v), off
 
     def copy(self, val):
+        if self.immutable:
+            return val
         codec = self._arm_codec(val.type)
         if codec is None:
             return self.cls(val.type, None)
+        if codec.immutable:
+            return self.cls(val.type, val.value)
         return self.cls(val.type, codec.copy(val.value))
 
 
@@ -571,7 +615,10 @@ def xunion(switch_codec, arms: Dict[Any, Optional[XdrCodec]], default_void=False
     """
 
     def deco(cls):
-        cls = dataclass(cls) if not dataclasses.is_dataclass(cls) else cls
+        if not dataclasses.is_dataclass(cls):
+            cls = dataclass(
+                cls, frozen=bool(getattr(cls, "XDR_VALUE_SEMANTICS", False))
+            )
         names = {f.name for f in dataclasses.fields(cls)}
         if not {"type", "value"} <= names:
             raise TypeError(f"{cls.__name__} must declare 'type' and 'value' fields")

@@ -37,12 +37,16 @@ class AssetType(enum.IntEnum):
 
 @xstruct
 class AssetAlphaNum4:
+    XDR_VALUE_SEMANTICS = True
+
     assetCode: bytes = xf(opaque(4))  # 1 to 4 characters
     issuer: PublicKey = xf(ACCOUNT_ID)
 
 
 @xstruct
 class AssetAlphaNum12:
+    XDR_VALUE_SEMANTICS = True
+
     assetCode: bytes = xf(opaque(12))  # 5 to 12 characters
     issuer: PublicKey = xf(ACCOUNT_ID)
 
@@ -97,6 +101,8 @@ ASSET = Asset._codec
 
 @xstruct
 class Price:
+    XDR_VALUE_SEMANTICS = True
+
     n: int = xf(int32, 0)  # numerator
     d: int = xf(int32, 1)  # denominator
 

@@ -26,6 +26,9 @@ class CryptoKeyType(enum.IntEnum):
 
 @xunion(xenum(CryptoKeyType), {CryptoKeyType.KEY_TYPE_ED25519: ("ed25519", UINT256)})
 class PublicKey:
+    # never mutated in place anywhere in the tree — xdr_copy shares instances
+    XDR_VALUE_SEMANTICS = True
+
     type: CryptoKeyType
     value: bytes = None
 

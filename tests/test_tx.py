@@ -517,3 +517,19 @@ class TestBaselineMeasurementConfigs:
         ).get_balance() == 10**9
         n_offers = app.database.query_one("SELECT COUNT(*) FROM offers")[0]
         assert n_offers == 1
+
+
+def test_op_shares_tx_signing_account(app, root):
+    """An op whose source is the tx source must get the SAME AccountFrame
+    object as the parent tx (reference: TransactionFrame::loadAccount reusing
+    mSigningAccount, src/transactions/TransactionFrame.cpp)."""
+    from stellar_tpu.ledger.accountframe import AccountFrame
+
+    a = SecretKey.pseudo_random_for_testing(900)
+    fund(app, root, a)
+    seq = AccountFrame.load_account(a.get_public_key(), app.database).get_seq_num()
+    tx = T.tx_from_ops(app, a, seq + 1, [T.payment_op(root, 1000)])
+    assert tx.load_account(app.database) is not None
+    op = tx.operations[0]
+    assert op.load_account(app.database)
+    assert op.source_account is tx.signing_account

@@ -295,3 +295,64 @@ def test_unpack_never_crashes_unsafely(data):
             cls.from_xdr(data)
         except XdrError:
             pass
+
+
+class TestXdrCopyAliasing:
+    """Contracts behind the codec copy fast paths: value-semantics types
+    are shared frozen instances; everything mutable stays independent."""
+
+    def _account_entry(self):
+        from stellar_tpu.xdr.entries import (
+            AccountEntry,
+            LedgerEntry,
+            LedgerEntryData,
+            LedgerEntryType,
+            Signer,
+        )
+        from stellar_tpu.xdr.xtypes import PublicKey
+
+        a = PublicKey.from_ed25519(b"\x01" * 32)
+        s = PublicKey.from_ed25519(b"\x02" * 32)
+        ae = AccountEntry(
+            accountID=a,
+            balance=100,
+            seqNum=1 << 32,
+            numSubEntries=1,
+            inflationDest=None,
+            flags=0,
+            homeDomain="x",
+            thresholds=b"\x01\x00\x00\x00",
+            signers=[Signer(s, 1)],
+        )
+        return LedgerEntry(5, LedgerEntryData(LedgerEntryType.ACCOUNT, ae), 0)
+
+    def test_mutable_parts_are_independent(self):
+        from stellar_tpu.xdr.base import xdr_copy
+        from stellar_tpu.xdr.entries import Signer
+        from stellar_tpu.xdr.xtypes import PublicKey
+
+        le = self._account_entry()
+        cp = xdr_copy(le)
+        orig = le.to_xdr()
+        # mutate every mutable layer of the original
+        le.lastModifiedLedgerSeq = 9
+        le.data.value.balance = 1
+        le.data.value.thresholds = b"\x02\x00\x00\x00"
+        le.data.value.signers.append(
+            Signer(PublicKey.from_ed25519(b"\x03" * 32), 2)
+        )
+        le.data.value.signers[0].weight = 7
+        assert cp.to_xdr() == orig, "copy must be unaffected by the original"
+
+    def test_value_semantics_instances_shared_and_frozen(self):
+        import dataclasses
+
+        import pytest
+
+        from stellar_tpu.xdr.base import xdr_copy
+
+        le = self._account_entry()
+        cp = xdr_copy(le)
+        assert cp.data.value.accountID is le.data.value.accountID
+        with pytest.raises(dataclasses.FrozenInstanceError):
+            cp.data.value.accountID.value = b"\x09" * 32
