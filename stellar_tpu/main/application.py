@@ -34,7 +34,13 @@ class AppState:
 
 
 class Application:
-    def __init__(self, clock: VirtualClock, config: Config, new_db: bool = False):
+    def __init__(
+        self,
+        clock: VirtualClock,
+        config: Config,
+        new_db: bool = False,
+        auto_init: bool = True,
+    ):
         self.clock = clock
         self.config = config
         if not config.NETWORK_PASSPHRASE:
@@ -57,7 +63,10 @@ class Application:
         self.command_handler = None
         self.process_manager = None
 
-        if new_db or self._needs_initialization():
+        if new_db or (auto_init and self._needs_initialization()):
+            # offline utility modes (--info/--loadxdr) pass auto_init=False:
+            # they must report an uninitialized DB, not silently create one
+            # (reference: checkInitialized, src/main/main.cpp:176-195)
             self.initialize_db()
 
     # -- creation ----------------------------------------------------------

@@ -12,6 +12,8 @@ Flags mirror the reference binary:
   --genfuzz FILE  write random fuzzer corpus seeds
   --fuzz FILE     replay a fuzz file into a loopback node pair
   --c CMD         send an admin command to a running node (HTTP)
+  --info          print node status from the database and exit
+  --loadxdr FILE  load an XDR bucket file into the database (testing)
   --ll LEVEL      log level (trace/debug/info/warning/error)
   --metric NAME   report this metric on exit (repeatable)
   --test [ARGS]   run the test suite (pytest passthrough)
@@ -161,6 +163,63 @@ def _set_force_scp(cfg, value: bool = True) -> int:
     return 0
 
 
+def _with_offline_app(cfg, fn) -> int:
+    """Run fn(app) against the existing database, without starting the
+    overlay/herder (reference: checkInitialized + offline helpers,
+    src/main/main.cpp:176-213)."""
+    from ..util.clock import VIRTUAL_TIME, VirtualClock
+    from .application import Application
+
+    clock = VirtualClock(VIRTUAL_TIME)
+    app = Application(clock, cfg, auto_init=False)
+    try:
+        if app._needs_initialization():
+            print("Database is not initialized", file=sys.stderr)
+            return 1
+        if app.ledger_manager.last_closed is None:
+            app.ledger_manager.load_last_known_ledger()
+        return fn(app)
+    finally:
+        app.graceful_stop()
+        clock.shutdown()
+
+
+def _report_info(cfg) -> int:
+    """--info (reference: main.cpp:420 -> Application::reportInfo)."""
+    from .commandhandler import CommandHandler
+
+    def report(app):
+        app.command_handler = CommandHandler(app)
+        print(json.dumps(app.command_handler.handle_info({}), indent=1))
+        return 0
+
+    return _with_offline_app(cfg, report)
+
+
+def _load_xdr(cfg, bucket_file: str) -> int:
+    """--loadxdr (reference: main.cpp:198-213 loadXdr): apply an XDR bucket
+    file's entries to the database, for testing."""
+    import hashlib
+    import os
+
+    from ..bucket.bucket import Bucket
+
+    if not os.path.exists(bucket_file):
+        print(f"no such file: {bucket_file}", file=sys.stderr)
+        return 1
+
+    def load(app):
+        # a default-constructed Bucket(path) has the zero hash, which means
+        # "empty" — hash the file (streamed) so apply actually replays it
+        with open(bucket_file, "rb") as f:
+            digest = hashlib.file_digest(f, "sha256").digest()
+        Bucket(bucket_file, hash=digest).apply(app.database)
+        print(f"applied {bucket_file}")
+        return 0
+
+    return _with_offline_app(cfg, load)
+
+
 def _run_node(cfg, new_db: bool, metrics) -> int:
     from ..util.clock import REAL_TIME, VirtualClock
     from .application import Application
@@ -235,6 +294,10 @@ def main(argv=None) -> int:
             new_db = True
         elif a == "--forcescp":
             mode = "forcescp"
+        elif a == "--info":
+            mode = "info"
+        elif a == "--loadxdr":
+            mode, mode_arg = "loadxdr", take()
         elif a == "--genseed":
             mode = "genseed"
         elif a == "--convertid":
@@ -293,6 +356,10 @@ def main(argv=None) -> int:
         xlog.add_file(cfg.LOG_FILE_PATH)
     if mode == "forcescp":
         return _set_force_scp(cfg)
+    if mode == "info":
+        return _report_info(cfg)
+    if mode == "loadxdr":
+        return _load_xdr(cfg, mode_arg)
     if mode == "newhist":
         return _new_hist(cfg, newhist)
     if cmds:

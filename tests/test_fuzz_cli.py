@@ -78,3 +78,91 @@ def test_cli_dumpxdr(tmp_path, capsys):
 
 def test_cli_unknown_flag():
     assert cli.main(["--nonsense"]) == 2
+
+
+def _write_node_cfg(tmp_path):
+    """Minimal standalone config backed by an on-disk sqlite DB."""
+    from stellar_tpu.crypto.keys import SecretKey
+
+    sk = SecretKey.pseudo_random_for_testing(808)
+    db = tmp_path / "cli-test.db"
+    cfg = tmp_path / "node.cfg"
+    cfg.write_text(
+        f'''HTTP_PORT = 0
+RUN_STANDALONE = true
+MANUAL_CLOSE = true
+NODE_IS_VALIDATOR = true
+NETWORK_PASSPHRASE = "cli offline test net"
+NODE_SEED = "{sk.get_strkey_seed()}"
+DATABASE = "sqlite3://{db}"
+BUCKET_DIR_PATH = "{tmp_path / "buckets"}"
+TMP_DIR_PATH = "{tmp_path / "tmp"}"
+[QUORUM_SET]
+THRESHOLD = 1
+VALIDATORS = ["{sk.get_strkey_public()}"]
+'''
+    )
+    return str(cfg)
+
+
+def test_cli_info_and_loadxdr(tmp_path, capsys):
+    """--newdb then --info (offline status from DB) then --loadxdr applies a
+    bucket file (reference: main.cpp --info / loadXdr, :198-213,420)."""
+    import json
+
+    from stellar_tpu.crypto.keys import SecretKey
+    from stellar_tpu.util.xdrstream import XDROutputFileStream
+    from stellar_tpu.xdr.entries import (
+        AccountEntry,
+        LedgerEntry as LE,
+        LedgerEntryData,
+        LedgerEntryType,
+    )
+    from stellar_tpu.xdr.ledger import BucketEntry, BucketEntryType
+
+    cfg = _write_node_cfg(tmp_path)
+    assert cli.main(["--conf", cfg, "--newdb"]) == 0
+    capsys.readouterr()
+
+    assert cli.main(["--conf", cfg, "--info"]) == 0
+    out = capsys.readouterr().out
+    info = json.loads(out)["info"]
+    assert info["ledger"]["num"] == 1
+    assert info["network"] == "cli offline test net"
+
+    # bucket file with one live account entry
+    sk = SecretKey.pseudo_random_for_testing(31337)
+    ae = AccountEntry(
+        accountID=sk.get_public_key(),
+        balance=777,
+        seqNum=1 << 32,
+        numSubEntries=0,
+        inflationDest=None,
+        flags=0,
+        homeDomain="",
+        thresholds=b"\x01\x00\x00\x00",
+        signers=[],
+    )
+    le = LE(2, LedgerEntryData(LedgerEntryType.ACCOUNT, ae), 0)
+    bf = str(tmp_path / "one.bucket")
+    with XDROutputFileStream(bf) as f:
+        f.write_one(BucketEntry(BucketEntryType.LIVEENTRY, le))
+
+    assert cli.main(["--conf", cfg, "--loadxdr", bf]) == 0
+    capsys.readouterr()
+
+    import sqlite3
+
+    db = sqlite3.connect(str(tmp_path / "cli-test.db"))
+    assert db.execute("SELECT count(*) FROM accounts").fetchone()[0] == 2
+
+    # missing file must fail loudly, not silently apply nothing
+    assert cli.main(["--conf", cfg, "--loadxdr", str(tmp_path / "nope")]) == 1
+
+
+def test_cli_info_refuses_uninitialized_db(tmp_path, capsys):
+    """--info against a fresh DB path must exit 1, not silently create a
+    genesis database (reference: checkInitialized, main.cpp:176-195)."""
+    cfg = _write_node_cfg(tmp_path)
+    assert cli.main(["--conf", cfg, "--info"]) == 1
+    assert "not initialized" in capsys.readouterr().err
