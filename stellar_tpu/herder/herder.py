@@ -762,7 +762,13 @@ class Herder(SCPDriver):
     # misc
     # ------------------------------------------------------------------
     def is_quorum_set_sane(self, node_id: NodeID, qset: SCPQuorumSet) -> bool:
-        return is_qset_sane(node_id, qset, allow_self_absent=not self.scp.is_validator)
+        # only the local, non-validating node may omit itself from its qset
+        # (reference: LocalNode::isQuorumSetSane, LocalNode.cpp:69-76 via
+        # HerderImpl.cpp:1396)
+        self_absent_ok = (
+            node_id == self.scp.node_id and not self.scp.is_validator
+        )
+        return is_qset_sane(node_id, qset, allow_self_absent=self_absent_ok)
 
     def dump_info(self) -> dict:
         return {

@@ -210,9 +210,13 @@ def _load_xdr(cfg, bucket_file: str) -> int:
 
     def load(app):
         # a default-constructed Bucket(path) has the zero hash, which means
-        # "empty" — hash the file (streamed) so apply actually replays it
+        # "empty" — hash the file (streamed; hashlib.file_digest is 3.11+
+        # but we support 3.10) so apply actually replays it
+        h = hashlib.sha256()
         with open(bucket_file, "rb") as f:
-            digest = hashlib.file_digest(f, "sha256").digest()
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+        digest = h.digest()
         Bucket(bucket_file, hash=digest).apply(app.database)
         print(f"applied {bucket_file}")
         return 0

@@ -31,6 +31,7 @@ class CommandHandler:
         self.app = app
         self.sock: Optional[socket.socket] = None
         self._clients: set = set()
+        self._profiling_dir: Optional[str] = None
         self.routes: Dict[str, Callable[[dict], object]] = {
             "info": self.handle_info,
             "metrics": self.handle_metrics,
@@ -50,6 +51,7 @@ class CommandHandler:
             "testacc": self.handle_testacc,
             "testtx": self.handle_testtx,
             "logrotate": self.handle_logrotate,
+            "profiler": self.handle_profiler,
         }
 
     # -- server plumbing ----------------------------------------------------
@@ -400,6 +402,39 @@ class CommandHandler:
         for real when LOG_FILE_PATH is configured)."""
         rotated = xlog.rotate()
         return {"status": "ok", "rotated": rotated}
+
+    def handle_profiler(self, q: dict) -> dict:
+        """/profiler?action=start[&dir=PATH] | action=stop — JAX device
+        profiler around the TPU crypto plane (SURVEY.md §5.1: the TPU
+        build's tracing hook; the reference's analogue is its medida
+        timers, which we also keep).  Traces are written as a TensorBoard
+        trace directory."""
+        import jax
+
+        action = q.get("action", "")
+        if action == "start":
+            if self._profiling_dir:
+                return {"error": "profiler already running"}
+            trace_dir = q.get("dir") or self.app.tmp_dirs.tmp_dir(
+                "jax-profile"
+            ).get_name()
+            try:
+                jax.profiler.start_trace(trace_dir)
+            except Exception as e:
+                return {"error": f"start_trace failed: {e}"}
+            self._profiling_dir = trace_dir
+            return {"status": "profiling", "dir": trace_dir}
+        if action == "stop":
+            if not self._profiling_dir:
+                return {"error": "profiler not running"}
+            try:
+                jax.profiler.stop_trace()
+            except Exception as e:
+                # keep _profiling_dir so a retry can attempt the stop again
+                return {"error": f"stop_trace failed: {e}"}
+            trace_dir, self._profiling_dir = self._profiling_dir, None
+            return {"status": "stopped", "dir": trace_dir}
+        return {"error": "action must be start or stop"}
 
     def handle_generateload(self, q: dict) -> dict:
         from ..simulation.loadgen import LoadGenerator

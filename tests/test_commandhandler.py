@@ -21,6 +21,8 @@ EXPECTED_ROUTES = {
     "generateload", "info", "ll", "logrotate", "maintenance",
     "manualclose", "metrics", "peers", "setcursor", "scp",
     "testacc", "testtx", "tx",
+    # TPU-native extras beyond the reference's table
+    "profiler",
 }
 
 
@@ -143,3 +145,19 @@ def test_logrotate_reopens_file(app, tmp_path):
             logging.getLogger("stellar_tpu").removeHandler(xlog._file_handler)
             xlog._file_handler.close()
             xlog._file_handler = None
+
+
+def test_profiler_route(app, tmp_path):
+    """/profiler start/stop wraps jax.profiler tracing (SURVEY.md §5.1)."""
+    import os
+
+    ch = app.command_handler
+    d = str(tmp_path / "trace")
+    r = ch.handle_profiler({"action": "start", "dir": d})
+    assert r.get("status") == "profiling", r
+    assert "error" in ch.handle_profiler({"action": "start"})  # double start
+    r = ch.handle_profiler({"action": "stop"})
+    assert r.get("status") == "stopped", r
+    assert os.path.isdir(d) and os.listdir(d), "trace dir must be written"
+    assert "error" in ch.handle_profiler({"action": "stop"})  # not running
+    assert "error" in ch.handle_profiler({})  # bad action

@@ -957,3 +957,218 @@ def test_restore_externalize_state():
     # the restored node re-serves its externalize statement
     out = n2.scp.get_latest_messages_send(1)
     assert out and out[-1].statement.pledges.type == ST.SCP_ST_EXTERNALIZE
+
+
+class TestBallotProtocolPorted3:
+    """Third batch ported from the reference core5 suite
+    (/root/reference/src/scp/SCPTests.cpp:436,800,874,1027,1228)."""
+
+    def test_non_validator_watching_the_network(self):
+        """SCPTests.cpp:436-459: a non-validator tracks the network's
+        externalize statements through CONFIRM to EXTERNALIZE."""
+        nv = SecretKey.pseudo_random_for_testing(99)
+        qset = qset5(4)
+        driver = ScriptedDriver([qset])
+        scp = SCP(driver, nv.get_public_key(), False, qset)
+        qs_hash = quorum.qset_hash(qset)
+        b = SCPBallot(1, X)
+
+        assert scp.get_slot(1).bump_state(X, force=True)
+        assert len(driver.emitted) == 1
+        ext = lambda: externalize_st(qs_hash, b, 1)
+        for i in (1, 2, 3):
+            assert (
+                scp.receive_envelope(make_env(i, 1, ext()))
+                == EnvelopeState.VALID
+            )
+        assert len(driver.emitted) == 2
+        pl = driver.emitted[-1].statement.pledges
+        assert pl.type == ST.SCP_ST_CONFIRM
+        assert pl.confirm.nPrepared == 1
+        assert pl.confirm.commit == b and pl.confirm.nP == 1
+        assert scp.receive_envelope(make_env(4, 1, ext())) == EnvelopeState.VALID
+        assert len(driver.emitted) == 3
+        pl = driver.emitted[-1].statement.pledges
+        assert pl.type == ST.SCP_ST_EXTERNALIZE
+        assert pl.externalize.commit == b and pl.externalize.nP == b.counter
+        assert driver.externalized == {1: X}
+
+    @pytest.mark.parametrize(
+        "a, expected",
+        [
+            (X, SCPBallot(1, Y)),
+            (X, SCPBallot(2, Y)),
+            (Y, SCPBallot(2, X)),
+        ],
+        ids=["1x-conf-1y", "1x-conf-2y", "1y-conf-2x"],
+    )
+    def test_prepare_a_confirms_prepared_b_by_quorum(self, a, expected):
+        """SCPTests.cpp:800-872: prepare (a); a quorum accepting (b)
+        prepared moves v0 to prepared then confirmed-prepared (c=P=b)."""
+        n = Core5()
+        assert n.scp.get_slot(1).bump_state(a, force=True)
+        assert len(n.emitted) == 1
+        assert n.last_emit().prepare.ballot == SCPBallot(1, a)
+
+        st = lambda: prepare_st(n.qs_hash, expected, prepared=expected)
+        assert n.recv(1, st()) == EnvelopeState.VALID
+        assert len(n.emitted) == 1  # one statement is not v-blocking
+        assert n.driver.heard == []
+
+        assert n.recv(2, st()) == EnvelopeState.VALID  # v-blocking: prepared
+        assert len(n.emitted) == 2
+        pl = n.last_emit()
+        assert pl.prepare.ballot == expected and pl.prepare.prepared == expected
+        assert pl.prepare.nC == 0 and pl.prepare.nP == 0
+
+        assert n.recv(3, st()) == EnvelopeState.VALID  # quorum: set P, c, b
+        assert len(n.emitted) == 3
+        pl = n.last_emit()
+        assert pl.prepare.ballot == expected and pl.prepare.prepared == expected
+        assert pl.prepare.nC == expected.counter
+        assert pl.prepare.nP == expected.counter
+        assert len(n.driver.heard) == 1
+        assert n.driver.externalized == {}
+
+    @pytest.mark.parametrize(
+        "a, expected",
+        [(X, SCPBallot(2, Y)), (Y, SCPBallot(2, X))],
+        ids=["1x-commit-2y", "1y-commit-2x"],
+    )
+    def test_prepared_a_accept_commit_by_quorum_b(self, a, expected):
+        """SCPTests.cpp:874-958: prepared (1,a); a quorum committing (b)
+        re-prepares v0 on (b) (keeping (1,a) as p') then accepts the
+        commit -> CONFIRM."""
+        n = Core5()
+        assert n.scp.get_slot(1).bump_state(a, force=True)
+        source = SCPBallot(1, a)
+        for i in (1, 2):
+            assert (
+                n.recv(
+                    i,
+                    prepare_st(
+                        n.qs_hash, source, prepared=source, nC=1, nP=1
+                    ),
+                )
+                == EnvelopeState.VALID
+            )
+        assert len(n.emitted) == 2  # moved to prepared (v-blocking)
+        pl = n.last_emit()
+        assert pl.prepare.ballot == source and pl.prepare.prepared == source
+
+        committing = lambda: prepare_st(
+            n.qs_hash,
+            expected,
+            prepared=expected,
+            nC=expected.counter,
+            nP=expected.counter,
+        )
+        assert n.recv(1, committing()) == EnvelopeState.VALID
+        assert len(n.emitted) == 2
+        assert n.driver.heard == []
+
+        assert n.recv(2, committing()) == EnvelopeState.VALID  # v-blocking
+        assert len(n.emitted) == 3
+        pl = n.last_emit()
+        assert pl.prepare.ballot == expected and pl.prepare.prepared == expected
+        assert pl.prepare.preparedPrime == source
+        assert pl.prepare.nC == 0 and pl.prepare.nP == 0
+
+        assert n.recv(3, committing()) == EnvelopeState.VALID  # quorum
+        assert len(n.emitted) == 4
+        pl = n.last_emit()
+        assert pl.type == ST.SCP_ST_CONFIRM
+        assert pl.confirm.nPrepared == expected.counter
+        assert pl.confirm.commit == expected
+        assert pl.confirm.nP == expected.counter
+        assert len(n.driver.heard) == 1
+
+    @pytest.mark.parametrize(
+        "a, b_val", [(X, Y), (Y, X)], ids=["commit-2y", "commit-2x"]
+    )
+    @pytest.mark.parametrize(
+        "extra_prepared, accept_extra_commit",
+        [(False, False), (True, False), (True, True)],
+        ids=["plain", "extra-prepared", "accept-extra-commit"],
+    )
+    def test_prepared_a_confirm_commit_b(
+        self, a, b_val, extra_prepared, accept_extra_commit
+    ):
+        """SCPTests.cpp:1027-1166: prepared (1,a); CONFIRMs on (2,b) drive
+        v0 through accept-commit to EXTERNALIZE, optionally raising p
+        (extra prepared) and P (accept extra commit) along the way."""
+        expected = SCPBallot(2, b_val)
+        n = Core5()
+        assert n.scp.get_slot(1).bump_state(a, force=True)
+        source = SCPBallot(1, a)
+        for i in (1, 2):
+            assert (
+                n.recv(
+                    i,
+                    prepare_st(n.qs_hash, source, prepared=source, nC=1, nP=1),
+                )
+                == EnvelopeState.VALID
+            )
+        assert len(n.emitted) == 2
+
+        conf = lambda p, P: confirm_st(n.qs_hash, p, expected, P)
+        assert n.recv(1, conf(expected.counter, expected.counter)) == EnvelopeState.VALID
+        assert len(n.emitted) == 2
+        assert n.recv(2, conf(expected.counter, expected.counter)) == EnvelopeState.VALID
+        assert len(n.emitted) == 3  # v-blocking: prepared + accept commit
+        pl = n.last_emit()
+        assert pl.type == ST.SCP_ST_CONFIRM
+        assert pl.confirm.nPrepared == expected.counter
+        assert pl.confirm.commit == expected
+        assert pl.confirm.nP == expected.counter
+
+        prepared = expected.counter
+        expected_p = expected.counter
+        emitted = 3
+        if extra_prepared:
+            prepared += 1
+            expected_p = prepared if accept_extra_commit else expected.counter
+            assert n.recv(1, conf(prepared, expected_p)) == EnvelopeState.VALID
+            assert len(n.emitted) == emitted
+            assert n.recv(2, conf(prepared, expected_p)) == EnvelopeState.VALID
+            emitted += 1
+            assert len(n.emitted) == emitted  # bumps p (and P) via v-blocking
+            pl = n.last_emit()
+            assert pl.type == ST.SCP_ST_CONFIRM
+            assert pl.confirm.nPrepared == prepared
+            assert pl.confirm.commit == expected
+            assert pl.confirm.nP == expected_p
+        assert n.driver.heard == []
+
+        assert n.recv(3, conf(prepared, expected_p)) == EnvelopeState.VALID
+        assert len(n.driver.heard) == 1
+        assert len(n.emitted) == emitted + 1
+        pl = n.last_emit()
+        assert pl.type == ST.SCP_ST_EXTERNALIZE
+        assert pl.externalize.commit == expected
+        assert pl.externalize.nP == expected_p
+        assert n.driver.externalized == {1: b_val}
+
+    def test_bump_to_ballot_prevented_after_confirm(self):
+        """SCPTests.cpp:1228-1266: once in CONFIRM on (1,x), a full set of
+        EXTERNALIZE statements for (2,y) must not move the node."""
+        n = Core5()
+        n.scp.get_slot(1).bump_state(X, force=True)
+        n.recv_quorum(lambda: prepare_st(n.qs_hash, SCPBallot(1, X)))
+        n.recv_quorum(
+            lambda: prepare_st(n.qs_hash, SCPBallot(1, X), prepared=SCPBallot(1, X))
+        )
+        n.recv_quorum(
+            lambda: prepare_st(
+                n.qs_hash, SCPBallot(1, X), prepared=SCPBallot(1, X), nC=1, nP=1
+            )
+        )
+        assert n.bp().phase == Phase.CONFIRM
+        emitted = len(n.emitted)
+
+        by = SCPBallot(2, Y)
+        for i in (1, 2, 3, 4):
+            n.recv(i, externalize_st(n.qs_hash, by, by.counter))
+        assert len(n.emitted) == emitted
+        assert n.bp().phase == Phase.CONFIRM
+        assert n.driver.externalized == {}
