@@ -21,7 +21,7 @@ from typing import Dict, List, Optional
 
 from ..crypto import PubKeyUtils, sha256
 from ..scp import SCP, SCPDriver
-from ..scp.quorum import is_qset_sane, qset_hash as compute_qset_hash
+from ..scp.quorum import qset_hash as compute_qset_hash
 from ..scp.slot import Slot
 from ..util import VirtualTimer, xlog
 from ..xdr.base import xdr_to_opaque
@@ -762,13 +762,9 @@ class Herder(SCPDriver):
     # misc
     # ------------------------------------------------------------------
     def is_quorum_set_sane(self, node_id: NodeID, qset: SCPQuorumSet) -> bool:
-        # only the local, non-validating node may omit itself from its qset
-        # (reference: LocalNode::isQuorumSetSane, LocalNode.cpp:69-76 via
-        # HerderImpl.cpp:1396)
-        self_absent_ok = (
-            node_id == self.scp.node_id and not self.scp.is_validator
-        )
-        return is_qset_sane(node_id, qset, allow_self_absent=self_absent_ok)
+        # delegates to SCP so the self-absence rule lives in one place
+        # (reference: HerderImpl.cpp:1396 -> LocalNode::isQuorumSetSane)
+        return self.scp.is_qset_sane_for(node_id, qset)
 
     def dump_info(self) -> dict:
         return {

@@ -98,6 +98,19 @@ class Application:
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
         """Load LCL, start overlay, maybe force SCP (ApplicationImpl::start)."""
+        # fail fast on a misconfigured quorum set before joining consensus
+        # (reference: ApplicationImpl.cpp:230-240)
+        cfg = self.config
+        if self.herder is not None:
+            if cfg.QUORUM_SET.threshold == 0:
+                raise ValueError("Quorum not configured")
+            if cfg.NODE_IS_VALIDATOR and not self.herder.is_quorum_set_sane(
+                cfg.NODE_SEED.get_public_key(), cfg.QUORUM_SET
+            ):
+                raise ValueError(
+                    "Invalid QUORUM_SET: bad threshold or validator is not"
+                    " a member"
+                )
         if self.persistent_state.get_state(K_DATABASE_INITIALIZED) == "true":
             if self.ledger_manager.last_closed is None:
                 self.ledger_manager.load_last_known_ledger()

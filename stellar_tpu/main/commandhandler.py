@@ -430,9 +430,21 @@ class CommandHandler:
             try:
                 jax.profiler.stop_trace()
             except Exception as e:
-                # keep _profiling_dir so a retry can attempt the stop again
+                # keep state for ONE retry (transient export I/O failure);
+                # a second failure — or JAX reporting no active session —
+                # clears it so the endpoint can't wedge until restart
+                self._profiler_stop_failures = (
+                    getattr(self, "_profiler_stop_failures", 0) + 1
+                )
+                if (
+                    self._profiler_stop_failures >= 2
+                    or "No profile" in str(e)
+                ):
+                    self._profiling_dir = None
+                    self._profiler_stop_failures = 0
                 return {"error": f"stop_trace failed: {e}"}
             trace_dir, self._profiling_dir = self._profiling_dir, None
+            self._profiler_stop_failures = 0
             return {"status": "stopped", "dir": trace_dir}
         return {"error": "action must be start or stop"}
 

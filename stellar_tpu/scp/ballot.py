@@ -189,15 +189,7 @@ class BallotProtocol:
     # -- sanity -------------------------------------------------------------
     def _is_statement_sane(self, st: SCPStatement) -> bool:
         qset = self.slot.quorum_set_from_statement(st)
-        # a non-validating local node may leave itself out of its own qset
-        # (reference: LocalNode::isQuorumSetSane, LocalNode.cpp:69-76)
-        self_absent_ok = (
-            st.nodeID == self.slot.local_node_id()
-            and not self.slot.scp.is_validator
-        )
-        if qset is None or not quorum.is_qset_sane(
-            st.nodeID, qset, allow_self_absent=self_absent_ok
-        ):
+        if qset is None or not self.slot.scp.is_qset_sane_for(st.nodeID, qset):
             return False
         pl = st.pledges
         if pl.type == ST.SCP_ST_PREPARE:

@@ -99,14 +99,8 @@ class NominationProtocol:
         if sorted(nom.votes) != list(nom.votes) or sorted(nom.accepted) != list(nom.accepted):
             return False
         qset = self.slot.quorum_set_from_statement(st)
-        # see BallotProtocol._is_statement_sane: a non-validating local
-        # node may leave itself out of its own qset (LocalNode.cpp:69-76)
-        self_absent_ok = (
-            st.nodeID == self.slot.local_node_id()
-            and not self.slot.scp.is_validator
-        )
-        return qset is not None and quorum.is_qset_sane(
-            st.nodeID, qset, allow_self_absent=self_absent_ok
+        return qset is not None and self.slot.scp.is_qset_sane_for(
+            st.nodeID, qset
         )
 
     def _record_envelope(self, env: SCPEnvelope) -> None:

@@ -57,6 +57,17 @@ class SCP:
         self.local_qset = qset
         self.local_qset_hash = quorum.qset_hash(qset)
 
+    def is_qset_sane_for(self, node_id: NodeID, qset: SCPQuorumSet) -> bool:
+        """Statement-level qset sanity.  The one exception to 'a node must
+        be a member of its own quorum set' is the local, NON-validating
+        node (reference: LocalNode::isQuorumSetSane, LocalNode.cpp:69-76);
+        all sanity checks route through here so the rule lives in one
+        place."""
+        self_absent_ok = node_id == self.node_id and not self.is_validator
+        return quorum.is_qset_sane(
+            node_id, qset, allow_self_absent=self_absent_ok
+        )
+
     # -- state management -------------------------------------------------------------
     def purge_slots(self, max_slot_index: int) -> None:
         for idx in [i for i in self.known_slots if i < max_slot_index]:

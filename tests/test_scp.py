@@ -1172,3 +1172,101 @@ class TestBallotProtocolPorted3:
         assert len(n.emitted) == emitted
         assert n.bp().phase == Phase.CONFIRM
         assert n.driver.externalized == {}
+
+
+Z = b"\x03" * 32  # X < Y < Z
+
+
+class TestNominationLeaderPriority:
+    """Leader-priority scenarios ported from the reference
+    (/root/reference/src/scp/SCPTests.cpp:1760-1886 "v1 is top node"):
+    the driver's hash hooks rig round-leader priority and value order."""
+
+    class PriorityDriver(ScriptedDriver):
+        def __init__(self, qsets):
+            super().__init__(qsets)
+            self.priority_node = None
+
+        def compute_hash_node(
+            self, slot_index, prev, is_priority, round_number, node_id
+        ):
+            # TestSCP::computeHashNode: priority from the lookup, neighbor
+            # hash 0 (every qset member passes the neighbor gate)
+            if is_priority:
+                return 1000 if node_id == self.priority_node else 1
+            return 0
+
+        def compute_value_hash(self, slot_index, prev, round_number, value):
+            return {X: 1, Y: 2, Z: 3}[value]
+
+    def _setup(self):
+        qset = qset5(4)
+        d = self.PriorityDriver([qset])
+        d.priority_node = NODES[1]
+        scp = SCP(d, NODES[0], True, qset)
+        qs_hash = quorum.qset_hash(qset)
+        nom1 = make_env(1, 1, nominate_st(qs_hash, [X, Y], []))
+        nom2 = make_env(2, 1, nominate_st(qs_hash, [X, Z], []))
+        return scp, d, qs_hash, nom1, nom2
+
+    def test_nomination_waits_for_v1(self):
+        scp, d, qs_hash, nom1, nom2 = self._setup()
+        assert not scp.get_slot(1).nominate(X, b"\x00" * 32)
+        assert d.emitted == []
+
+        nom3 = make_env(3, 1, nominate_st(qs_hash, [Y, Z], []))
+        nom4 = make_env(4, 1, nominate_st(qs_hash, [X, Z], []))
+        # nothing happens with non-top nodes
+        scp.receive_envelope(nom2)
+        scp.receive_envelope(nom3)
+        assert d.emitted == []
+        # v1's nomination arrives: v0 echoes v1's best value (y)
+        scp.receive_envelope(nom1)
+        assert len(d.emitted) == 1
+        nom = d.emitted[-1].statement.pledges.nominate
+        assert nom.votes == [Y] and nom.accepted == []
+        scp.receive_envelope(nom4)
+        assert len(d.emitted) == 1
+
+    def test_timeout_picks_another_value_from_v1(self):
+        scp, d, qs_hash, nom1, nom2 = self._setup()
+        assert not scp.get_slot(1).nominate(X, b"\x00" * 32)
+        scp.receive_envelope(nom2)
+        scp.receive_envelope(nom1)
+        scp.receive_envelope(make_env(4, 1, nominate_st(qs_hash, [X, Z], [])))
+        assert len(d.emitted) == 1
+
+        # timeout: the value passed in is ignored; v0 picks up x from v1
+        # (it already votes y), and with v1/v2/v4 also voting x that is a
+        # quorum -> x accepted
+        assert scp.get_slot(1).nominate(Z, b"\x00" * 32, timed_out=True)
+        assert len(d.emitted) == 2
+        nom = d.emitted[-1].statement.pledges.nominate
+        assert nom.votes == sorted([X, Y]) and nom.accepted == [X]
+
+    @pytest.mark.parametrize(
+        "new_top, expect_votes",
+        [(0, [X]), (2, [Z])],
+        ids=["v0-new-top", "v2-new-top"],
+    )
+    def test_v1_dead_timeout_new_top(self, new_top, expect_votes):
+        scp, d, qs_hash, nom1, nom2 = self._setup()
+        assert not scp.get_slot(1).nominate(X, b"\x00" * 32)
+        assert d.emitted == []
+        scp.receive_envelope(nom2)
+        assert d.emitted == []
+
+        d.priority_node = NODES[new_top]
+        assert scp.get_slot(1).nominate(X, b"\x00" * 32, timed_out=True)
+        assert len(d.emitted) == 1
+        nom = d.emitted[-1].statement.pledges.nominate
+        assert nom.votes == expect_votes and nom.accepted == []
+
+    def test_v1_dead_timeout_v3_new_top(self):
+        scp, d, qs_hash, nom1, nom2 = self._setup()
+        assert not scp.get_slot(1).nominate(X, b"\x00" * 32)
+        scp.receive_envelope(nom2)
+
+        d.priority_node = NODES[3]  # no envelope from v3: nothing happens
+        assert not scp.get_slot(1).nominate(X, b"\x00" * 32, timed_out=True)
+        assert d.emitted == []

@@ -533,3 +533,31 @@ def test_op_shares_tx_signing_account(app, root):
     op = tx.operations[0]
     assert op.load_account(app.database)
     assert op.source_account is tx.signing_account
+
+
+def test_start_rejects_insane_quorum_set(clock):
+    """A validator whose configured QUORUM_SET omits itself must fail fast
+    at start (reference: ApplicationImpl.cpp:230-240)."""
+    cfg = T.get_test_config(81)
+    cfg.QUORUM_SET = X.SCPQuorumSet(
+        threshold=1,
+        validators=[SecretKey.pseudo_random_for_testing(999).get_public_key()],
+        innerSets=[],
+    )
+    a = Application.create(clock, cfg, new_db=True)
+    try:
+        with pytest.raises(ValueError, match="QUORUM_SET"):
+            a.start()
+    finally:
+        a.database.close()
+
+
+def test_start_rejects_zero_threshold_quorum(clock):
+    cfg = T.get_test_config(82)
+    cfg.QUORUM_SET = X.SCPQuorumSet(threshold=0, validators=[], innerSets=[])
+    a = Application.create(clock, cfg, new_db=True)
+    try:
+        with pytest.raises(ValueError, match="Quorum not configured"):
+            a.start()
+    finally:
+        a.database.close()
