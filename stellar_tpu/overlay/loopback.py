@@ -45,6 +45,7 @@ class LoopbackPeer(Peer):
     def send_frame(self, data: bytes) -> None:
         if self._closed or self.remote is None:
             return
+        self.wrote_bytes()  # loopback "wire" = the remote's queue
         self.out_queue.append(data)
         while len(self.out_queue) > self.max_queue_depth:
             self.out_queue.popleft()  # shed oldest (queue-bounded transport)

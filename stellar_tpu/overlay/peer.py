@@ -23,6 +23,7 @@ from ..crypto import sha256
 from ..crypto.sha import hmac_sha256, hmac_sha256_verify
 from ..crypto.sodium import randombytes
 from ..util import xlog
+from ..util.clock import VirtualTimer
 from ..xdr.base import uint64, xdr_to_opaque
 from ..xdr.overlay import (
     Auth,
@@ -100,6 +101,48 @@ class Peer:
         self._m_drop = app.metrics.new_meter(("overlay", "drop", "count"), "drop")
         self._m_recv = app.metrics.new_meter(("overlay", "message", "read"), "message")
         self._m_sent = app.metrics.new_meter(("overlay", "message", "write"), "message")
+        self._m_timeout_idle = app.metrics.new_meter(
+            ("overlay", "timeout", "idle"), "timeout"
+        )
+        # idle-drop timer (Peer::startIdleTimer, Peer.cpp:231-264): a peer
+        # silent in both directions for io_timeout_seconds is dropped —
+        # 5s during handshake, 30s once authenticated.  The transports
+        # stamp last_read/last_write at the BYTE level (received_bytes/
+        # wrote_bytes), so a slow large frame counts as activity and a
+        # dead connection with queued-but-unsent output does not.
+        self.last_read = app.clock.now()
+        self.last_write = app.clock.now()
+        self._idle_timer = VirtualTimer(app.clock)
+        self._start_idle_timer()
+
+    def io_timeout_seconds(self) -> int:
+        return 30 if self.is_authenticated() else 5
+
+    def received_bytes(self) -> None:
+        """Transport hook: any inbound bytes count as read activity
+        (Peer::receivedBytes — per byte, not per decoded frame)."""
+        self.last_read = self.app.clock.now()
+
+    def wrote_bytes(self) -> None:
+        """Transport hook: bytes actually flushed to the wire count as
+        write activity (queued-but-unsent output does not)."""
+        self.last_write = self.app.clock.now()
+
+    def _start_idle_timer(self) -> None:
+        if self.should_abort():
+            return
+        self._idle_timer.expires_from_now(self.io_timeout_seconds())
+        self._idle_timer.async_wait(self._idle_timer_expired)
+
+    def _idle_timer_expired(self) -> None:
+        now = self.app.clock.now()
+        timeout = self.io_timeout_seconds()
+        if now - self.last_read >= timeout and now - self.last_write >= timeout:
+            log.warning("idle timeout on %r", self)
+            self._m_timeout_idle.mark()
+            self.drop()
+        else:
+            self._start_idle_timer()
 
     # -- abstract transport -------------------------------------------------
     def send_frame(self, data: bytes) -> None:
@@ -212,6 +255,7 @@ class Peer:
 
     # -- inbound ------------------------------------------------------------
     def recv_frame(self, data: bytes) -> None:
+        self.received_bytes()
         try:
             amsg = AuthenticatedMessage.from_xdr(data)
         except Exception as e:
@@ -421,6 +465,7 @@ class Peer:
                 pass
         self.state = PeerState.CLOSING
         self._m_drop.mark()
+        self._idle_timer.cancel()
         om = self.app.overlay_manager
         if om is not None:
             om.drop_peer(self)

@@ -109,6 +109,7 @@ class TCPPeer(Peer):
         if not chunk:
             self.drop()  # EOF
             return
+        self.received_bytes()  # partial frames still count as activity
         self._rbuf += chunk
         # decode as many complete frames as arrived; batch SCP pre-warm
         # happens naturally since each recv_frame call runs back-to-back
@@ -139,6 +140,8 @@ class TCPPeer(Peer):
                 log.info("write error to %r: %s", self, e)
                 self.drop()
                 return
+            if n > 0:
+                self.wrote_bytes()  # only bytes accepted by the kernel
             self._wpos += n
             if self._wpos >= len(buf):
                 self._wbuf.popleft()

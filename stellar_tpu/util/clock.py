@@ -129,6 +129,23 @@ class VirtualClock:
             heapq.heappop(self._timers)
         return self._timers[0][0] if self._timers else None
 
+    def has_ready_work(self) -> bool:
+        """True if a crank would process something WITHOUT leaping virtual
+        time: posted callbacks, watched IO, or an already-due timer.  Lets
+        test harnesses crank to quiescence instead of leaping into
+        far-future deadlines (e.g. peers' 30s idle-drop timers)."""
+        with self._xlock:
+            if self._xqueue:
+                return True
+        if self._queue:
+            return True
+        # a watched-but-quiet socket is NOT ready work: probe with a
+        # zero-timeout select (nothing is consumed by selecting)
+        if self._n_watched > 0 and self._selector.select(0):
+            return True
+        nd = self.next_deadline()
+        return nd is not None and nd <= self.now()
+
     # -- the crank ---------------------------------------------------------
     def crank(self, block: bool = False, max_block: Optional[float] = None) -> int:
         """Run one burst of ready work; returns number of events processed.

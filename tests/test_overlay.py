@@ -33,8 +33,19 @@ def make_app(clock, instance, manual_close=True):
     return app
 
 
-def crank(clock, n=80):
+def crank(clock, n=80, budget=4.0):
+    """Reference crankSome (OverlayTests.cpp:23-32) semantics: drain ready
+    work bounded by a virtual-time budget, and stop when only far-future
+    deadlines remain instead of leaping into them — peers drop on 5s/30s
+    idle timeouts like the reference, so an unbounded deadline-jump would
+    kill every idle connection."""
+    deadline = clock.now() + budget
     for _ in range(n):
+        if clock.now() >= deadline:
+            break
+        nd = clock.next_deadline()
+        if not clock.has_ready_work() and (nd is None or nd > deadline):
+            break
         clock.crank()
 
 
@@ -265,3 +276,75 @@ def test_handshake_rejects_damaged_auth(two_apps):
     crank(clock)
     assert not conn.acceptor.is_authenticated()
     assert not conn.initiator.is_authenticated()
+
+
+# -- admission policies (reference: OverlayTests.cpp:68-130,204) ------------
+
+
+def test_reject_peers_that_dont_handshake_quickly(two_apps):
+    """OverlayTests.cpp:204-230: a corked initiator stalls the handshake;
+    the 5s idle timer must drop both ends within 8 virtual seconds."""
+    clock, a, b = two_apps
+    conn = LoopbackPeerConnection(a, b)
+    conn.initiator.corked = True
+    conn.acceptor.corked = True
+    start = clock.now()
+    ok = clock.crank_until(
+        lambda: conn.initiator.state == PeerState.CLOSING
+        and conn.acceptor.state == PeerState.CLOSING,
+        10,
+    )
+    assert ok
+    assert clock.now() - start < 8.0
+    idle = b.metrics.new_meter(("overlay", "timeout", "idle"), "timeout")
+    assert idle.count != 0
+
+
+def test_reject_non_preferred_peer_when_strict(two_apps):
+    """OverlayTests.cpp:68-88: PREFERRED_PEERS_ONLY drops everyone not on
+    the preferred list after the handshake."""
+    clock, a, b = two_apps
+    b.config.PREFERRED_PEERS_ONLY = True
+    conn = LoopbackPeerConnection(a, b)
+    crank(clock)
+    assert conn.acceptor.state == PeerState.CLOSING
+    assert not conn.initiator.is_authenticated()
+
+
+def test_accept_preferred_peer_even_when_strict(two_apps):
+    """OverlayTests.cpp:89-108: a peer on PREFERRED_PEER_KEYS authenticates
+    even under PREFERRED_PEERS_ONLY."""
+    from stellar_tpu.crypto.keys import PubKeyUtils
+
+    clock, a, b = two_apps
+    b.config.PREFERRED_PEERS_ONLY = True
+    b.config.PREFERRED_PEER_KEYS = [
+        PubKeyUtils.to_strkey(a.config.NODE_SEED.get_public_key())
+    ]
+    conn = LoopbackPeerConnection(a, b)
+    crank(clock)
+    assert conn.acceptor.is_authenticated()
+    assert conn.initiator.is_authenticated()
+
+
+def test_reject_peers_beyond_max(two_apps):
+    """OverlayTests.cpp:109-129: no new connections once MAX_PEER_CONNECTIONS
+    is reached."""
+    clock, a, b = two_apps
+    b.config.MAX_PEER_CONNECTIONS = 0
+    conn = LoopbackPeerConnection(a, b)
+    crank(clock)
+    assert not conn.acceptor.is_authenticated()
+    assert conn.acceptor.state == PeerState.CLOSING
+
+
+def test_reject_incompatible_overlay_version(two_apps):
+    """OverlayTests.cpp:171-203: peers advertising an overlay protocol range
+    outside ours are rejected during the handshake."""
+    clock, a, b = two_apps
+    a.config.OVERLAY_PROTOCOL_MIN_VERSION = 99
+    a.config.OVERLAY_PROTOCOL_VERSION = 100
+    conn = LoopbackPeerConnection(a, b)
+    crank(clock)
+    assert not conn.initiator.is_authenticated()
+    assert not conn.acceptor.is_authenticated()
