@@ -86,6 +86,21 @@ class BucketManager:
         with self._lock:
             return h in self._buckets or os.path.exists(self.bucket_filename(h))
 
+    def check_for_missing_bucket_files(self, has) -> list:
+        """Hashes referenced by a HistoryArchiveState with no file on disk,
+        deduplicated — one hash can back several levels/merges (reference:
+        BucketManagerImpl::checkForMissingBucketsFiles, used by the
+        boot-time bucket repair at LedgerManagerImpl.cpp:233-247)."""
+        missing = []
+        for h in has.all_bucket_hashes():
+            if (
+                h != ZERO_HASH
+                and h not in missing
+                and not os.path.exists(self.bucket_filename(h))
+            ):
+                missing.append(h)
+        return missing
+
     # -- ledger-close interface (LedgerManager calls these) ----------------
     def add_batch(self, ledger_seq: int, live_entries, dead_entries) -> None:
         self.bucket_list.add_batch(self.app, ledger_seq, live_entries, dead_entries)

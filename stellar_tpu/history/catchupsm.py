@@ -30,6 +30,7 @@ from ..xdr.ledger import (
 )
 from .archive import WELL_KNOWN_PATH, HistoryArchive, HistoryArchiveState
 from .filetransfer import (
+    CAT_BUCKET,
     CAT_LEDGER,
     CAT_TRANSACTIONS,
     FILE_FAILED,
@@ -41,6 +42,10 @@ log = xlog.logger("History")
 
 CATCHUP_MINIMAL = "minimal"
 CATCHUP_COMPLETE = "complete"
+# fetch bucket files referenced by a known-good local state but missing on
+# disk (reference: CATCHUP_BUCKET_REPAIR, HistoryManager.h:197,
+# HistoryManagerImpl::downloadMissingBuckets at .cpp:700)
+CATCHUP_BUCKET_REPAIR = "bucket-repair"
 
 MAX_RETRIES = 5
 RETRY_DELAY_SECONDS = 2.0
@@ -52,13 +57,18 @@ class CatchupStateMachine:
         app,
         mode: str,
         done: Callable[[bool, Optional[object]], None],
+        desired_state: Optional[HistoryArchiveState] = None,
     ):
         """``done(ok, anchor_header_frame_or_None)`` fires on completion.
         The fetch range is derived from the local LCL and the archive
-        anchor, not from the ledgers that triggered the catchup."""
+        anchor, not from the ledgers that triggered the catchup.  In
+        CATCHUP_BUCKET_REPAIR mode, ``desired_state`` names the buckets the
+        LOCAL node needs (the archive's own state is only used to pick a
+        reachable archive)."""
         self.app = app
         self.mode = mode
         self.done = done
+        self.desired_state = desired_state
         self.state = "BEGIN"
         self.retries = 0
         self.archive: Optional[HistoryArchive] = None
@@ -104,6 +114,21 @@ class CatchupStateMachine:
     # -- ANCHORED: pick range, queue files ---------------------------------
     def _anchored(self) -> None:
         self.state = "ANCHORED"
+        if self.mode == CATCHUP_BUCKET_REPAIR:
+            # repair wants the LOCAL state's buckets, regardless of how far
+            # along the archive is (CatchupStateMachine.cpp:564-573)
+            bm = self.app.bucket_manager
+            missing = bm.check_for_missing_bucket_files(self.desired_state)
+            for h in self.app.history_manager.missing_publish_queue_buckets():
+                if h not in missing:
+                    missing.append(h)
+            self._fetch(
+                [
+                    FileTransferInfo.for_bucket(self.tmp.get_name(), h)
+                    for h in missing
+                ]
+            )
+            return
         anchor = self.has.current_ledger
         lcl = self.app.ledger_manager.get_last_closed_ledger_num()
         if anchor <= lcl:
@@ -189,6 +214,11 @@ class CatchupStateMachine:
     # -- VERIFYING: ledger-header hash chain -------------------------------
     def _verify(self, files: List[FileTransferInfo]) -> None:
         self.state = "VERIFYING"
+        if self.mode == CATCHUP_BUCKET_REPAIR:
+            # bucket files verify against their own content hash during
+            # adoption (CatchupStateMachine.cpp:718-721); no header chain
+            self._apply(files)
+            return
         try:
             self.headers.clear()
             self.tx_sets.clear()
@@ -242,6 +272,17 @@ class CatchupStateMachine:
     # -- APPLYING ----------------------------------------------------------
     def _apply(self, files: List[FileTransferInfo]) -> None:
         self.state = "APPLYING"
+        if self.mode == CATCHUP_BUCKET_REPAIR:
+            try:
+                self._adopt_bucket_files(files)
+            except Exception as e:
+                log.error("bucket repair: adopt failed: %s", e)
+                self._retry()
+                return
+            self.state = "END"
+            self.done(True, None)
+            self.app.tmp_dirs.forget(self.tmp)
+            return
         try:
             if self.mode == CATCHUP_MINIMAL:
                 self._apply_minimal(files)
@@ -264,11 +305,28 @@ class CatchupStateMachine:
             return
         self.app.tmp_dirs.forget(self.tmp)
 
+    def _adopt_bucket_files(self, files: List[FileTransferInfo]) -> None:
+        """Verify each fetched bucket file against its content hash and
+        adopt it into the bucket dir."""
+        from ..crypto import SHA256
+
+        bm = self.app.bucket_manager
+        for fi in files:
+            if fi.category != CAT_BUCKET:
+                continue
+            h = SHA256()
+            with open(fi.local_path, "rb") as f:
+                h.add(f.read())
+            got = h.finish()
+            want = bytes.fromhex(fi.base_name[7:-4])
+            if got != want:
+                raise RuntimeError(f"bucket {fi.base_name} hash mismatch")
+            bm.adopt_file_as_bucket(fi.local_path, want, 0)
+
     def _apply_minimal(self, files: List[FileTransferInfo]) -> None:
         """Adopt fetched buckets, wipe ledger-object state, replay buckets
         oldest→newest, assume the bucket-list shape."""
         from ..bucket.bucket import ZERO_HASH
-        from ..crypto import SHA256
 
         # validate BEFORE any destructive step: the HAS must reconstruct
         # the anchor header's bucketListHash, or this archive is lying and
@@ -278,19 +336,8 @@ class CatchupStateMachine:
             raise RuntimeError(
                 "archive bucket list does not hash to the anchor header"
             )
+        self._adopt_bucket_files(files)
         bm = self.app.bucket_manager
-        for fi in files:
-            if fi.category != "bucket":
-                continue
-            # adopt under its content hash (recompute to verify)
-            h = SHA256()
-            with open(fi.local_path, "rb") as f:
-                h.add(f.read())
-            got = h.finish()
-            want = bytes.fromhex(fi.base_name[7:-4])
-            if got != want:
-                raise RuntimeError(f"bucket {fi.base_name} hash mismatch")
-            bm.adopt_file_as_bucket(fi.local_path, want, 0)
         db = self.app.database
         with db.transaction():
             for table in ("accounts", "signers", "trustlines", "offers"):

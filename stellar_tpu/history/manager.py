@@ -43,6 +43,10 @@ class HistoryManager:
     def has_writable_archives(self) -> bool:
         return any(spec.get("put") for spec in self.app.config.HISTORY.values())
 
+    @property
+    def has_readable_archives(self) -> bool:
+        return any(spec.get("get") for spec in self.app.config.HISTORY.values())
+
     def next_checkpoint_ledger(self, ledger: int) -> int:
         return checkpoint_containing_ledger(ledger, self.checkpoint_frequency)
 
@@ -117,6 +121,52 @@ class HistoryManager:
             done_cb = self.app.ledger_manager.catchup_finished
         self.catchup = CatchupStateMachine(self.app, mode, done_cb)
         self.catchup.begin()
+
+    # -- bucket repair (HistoryManagerImpl::downloadMissingBuckets) --------
+    def download_missing_buckets(
+        self, state_json: str, handler: Callable[[bool], None]
+    ) -> None:
+        """Fetch bucket files referenced by ``state_json`` (and the publish
+        queue) that are missing from the bucket dir, then call
+        ``handler(ok)`` (reference: HistoryManagerImpl.cpp:700-718)."""
+        from .archive import HistoryArchiveState
+        from .catchupsm import CATCHUP_BUCKET_REPAIR
+
+        if self.catchup is not None and self.catchup.state not in (
+            "END",
+            "FAILED",
+        ):
+            raise RuntimeError("a catchup state machine is already running")
+        desired = HistoryArchiveState.from_json(state_json)
+
+        def done(ok, _anchor):
+            self.catchup = None
+            handler(ok)
+
+        self.catchup = CatchupStateMachine(
+            self.app, CATCHUP_BUCKET_REPAIR, done, desired_state=desired
+        )
+        self.catchup.begin()
+
+    def missing_publish_queue_buckets(self) -> list:
+        """Bucket hashes referenced by queued-but-unpublished checkpoints
+        with no file on disk (reference:
+        getMissingBucketsReferencedByPublishQueue)."""
+        from .archive import HistoryArchiveState
+
+        bm = self.app.bucket_manager
+        missing = []
+        for _seq, state_json in publish_queue.queued_checkpoints(
+            self.app.database
+        ):
+            try:
+                has = HistoryArchiveState.from_json(state_json)
+            except Exception:
+                continue
+            for h in bm.check_for_missing_bucket_files(has):
+                if h not in missing:
+                    missing.append(h)
+        return missing
 
     def get_publish_success_count(self) -> int:
         return self._publish_success

@@ -133,12 +133,50 @@ class LedgerManager:
         # merges) before anything recomputes the bucket hash
         has = ps.get_state(K_HISTORY_ARCHIVE_STATE)
         if has:
+            self._repair_missing_buckets(has)
             self.app.bucket_manager.assume_state(has)
             if self.app.bucket_manager.get_hash() != frame.header.bucketListHash:
                 raise RuntimeError("bucket list hash does not match resumed header")
         self.current = frame
         self._advance_ledger_pointers()
         self.state = LedgerState.LM_SYNCED_STATE
+
+    def _repair_missing_buckets(self, state_json: str) -> None:
+        """Boot-time bucket repair: fetch bucket files named by the saved
+        archive state (or the publish queue) that are missing on disk from
+        a history archive before assuming the bucket list (reference:
+        LedgerManagerImpl.cpp:233-247 -> downloadMissingBuckets)."""
+        from ..history.archive import HistoryArchiveState
+
+        bm = self.app.bucket_manager
+        hm = self.app.history_manager
+        missing = bm.check_for_missing_bucket_files(
+            HistoryArchiveState.from_json(state_json)
+        )
+        for h in hm.missing_publish_queue_buckets():
+            if h not in missing:
+                missing.append(h)
+        if not missing:
+            return
+        log.warning(
+            "%d bucket file(s) missing from the bucket dir; attempting to"
+            " recover from the history store",
+            len(missing),
+        )
+        if not hm.has_readable_archives:
+            raise RuntimeError(
+                "bucket files missing and no readable history archives"
+                " configured"
+            )
+        result = {}
+        hm.download_missing_buckets(
+            state_json, lambda ok: result.update(ok=ok)
+        )
+        # boot is synchronous: crank the (not-yet-running) clock until the
+        # repair's subprocess pipeline completes
+        self.app.clock.crank_until(lambda: "ok" in result, timeout=300.0)
+        if not result.get("ok"):
+            raise RuntimeError("bucket repair from history archives failed")
 
     # -- externalize path (LedgerManagerImpl.cpp:321-408) ------------------
     def externalize_value(self, ledger_data) -> None:

@@ -223,3 +223,57 @@ def test_second_checkpoint_and_catchup_across_two(clock, fresh_archive):
             assert AccountFrame.load_account(dest.get_public_key(), app2.database)
     finally:
         app2.graceful_stop()
+
+
+def test_repair_missing_buckets_via_history(clock, fresh_archive, tmp_path):
+    """HistoryTests.cpp:800-862 'Repair missing buckets via history': delete
+    the bucket files after a publish, restart on the same database — boot
+    must fetch the missing buckets back from the archive before assuming
+    the bucket list."""
+    cfg = T.get_test_config(27)
+    cfg.CHECKPOINT_FREQUENCY = FREQ
+    cfg.HISTORY = archive_config(fresh_archive, True)
+    cfg.DATABASE = f"sqlite3://{tmp_path / 'repair.db'}"
+    shutil.rmtree(cfg.BUCKET_DIR_PATH, ignore_errors=True)
+    app = Application.create(clock, cfg, new_db=True)
+    app.start()
+    publish_checkpoint(app, clock, accounts=True)
+    want_hash = app.bucket_manager.get_hash()
+    bucket_dir = app.bucket_manager.bucket_dir
+    app.graceful_stop()
+
+    removed = [f for f in glob.glob(os.path.join(bucket_dir, "bucket-*.xdr"))]
+    assert removed, "publish must have left bucket files on disk"
+    for f in removed:
+        os.unlink(f)
+
+    app2 = Application.create(clock, cfg, new_db=False)
+    app2.start()  # load_last_known_ledger -> bucket repair -> assume_state
+    assert app2.bucket_manager.get_hash() == want_hash
+    assert app2.ledger_manager.is_synced()
+    app2.graceful_stop()
+
+
+def test_boot_fails_without_archives_when_buckets_missing(clock, tmp_path):
+    """Missing bucket files with no configured archives must fail fast, not
+    boot with a wrong bucket list."""
+    cfg = T.get_test_config(28)
+    cfg.CHECKPOINT_FREQUENCY = FREQ
+    cfg.DATABASE = f"sqlite3://{tmp_path / 'norepair.db'}"
+    shutil.rmtree(cfg.BUCKET_DIR_PATH, ignore_errors=True)
+    app = Application.create(clock, cfg, new_db=True)
+    app.start()
+    for _ in range(3):
+        close_one(app, clock, [])
+    bucket_dir = app.bucket_manager.bucket_dir
+    app.graceful_stop()
+
+    files = glob.glob(os.path.join(bucket_dir, "bucket-*.xdr"))
+    assert files
+    for f in files:
+        os.unlink(f)
+
+    app2 = Application.create(clock, cfg, new_db=False)
+    with pytest.raises(RuntimeError, match="history archives"):
+        app2.start()
+    app2.database.close()
