@@ -114,6 +114,10 @@ class Application:
         if self.persistent_state.get_state(K_DATABASE_INITIALIZED) == "true":
             if self.ledger_manager.last_closed is None:
                 self.ledger_manager.load_last_known_ledger()
+            # drain any checkpoints queued before a crash/restart — the
+            # publish queue is DB-persisted exactly so this can resume
+            # (reference: publishQueuedHistory on start)
+            self.clock.post(self.history_manager.publish_queued_history)
         force = (
             self.config.FORCE_SCP
             or self.persistent_state.get_state(K_FORCE_SCP_ON_NEXT_LAUNCH) == "true"

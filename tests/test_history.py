@@ -277,3 +277,43 @@ def test_boot_fails_without_archives_when_buckets_missing(clock, tmp_path):
     with pytest.raises(RuntimeError, match="history archives"):
         app2.start()
     app2.database.close()
+
+
+def test_persist_publish_queue_across_restart(clock, fresh_archive, tmp_path):
+    """HistoryTests.cpp:873-930 'persist publish queue': checkpoints queued
+    while the archive is unreachable survive a restart and publish once the
+    archive works again."""
+    cfg = T.get_test_config(29)
+    cfg.CHECKPOINT_FREQUENCY = FREQ
+    # a put command that always fails: everything stays queued
+    cfg.HISTORY = {"test": {
+        "get": f"cp {fresh_archive}/{{0}} {{1}}",
+        "put": "false",
+        "mkdir": "true",
+    }}
+    cfg.DATABASE = f"sqlite3://{tmp_path / 'queue.db'}"
+    shutil.rmtree(cfg.BUCKET_DIR_PATH, ignore_errors=True)
+    app = Application.create(clock, cfg, new_db=True)
+    app.start()
+    # close through two checkpoint boundaries
+    while len(publish_queue.queued_checkpoints(app.database)) < 2:
+        close_one(app, clock, [])
+    assert app.history_manager.get_publish_success_count() == 0
+    queued = [s for s, _ in publish_queue.queued_checkpoints(app.database)]
+    app.graceful_stop()
+
+    # restart with a working archive: boot drains the persisted queue
+    cfg.HISTORY = archive_config(fresh_archive, writable=True)
+    app2 = Application.create(clock, cfg, new_db=False)
+    app2.start()
+    assert [
+        s for s, _ in publish_queue.queued_checkpoints(app2.database)
+    ] == queued
+    assert clock.crank_until(
+        lambda: app2.history_manager.get_publish_success_count()
+        >= len(queued),
+        30,
+    )
+    assert publish_queue.queued_checkpoints(app2.database) == []
+    assert os.path.isdir(os.path.join(fresh_archive, "bucket"))
+    app2.graceful_stop()
