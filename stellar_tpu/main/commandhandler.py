@@ -456,5 +456,13 @@ class CommandHandler:
         rate = int(q.get("txrate", 10))
         if not hasattr(self.app, "load_generator") or self.app.load_generator is None:
             self.app.load_generator = LoadGenerator()
-        self.app.load_generator.generate_load(self.app, accounts, txs, rate)
-        return {"status": f"Generating load: {accounts} accounts, {txs} txs, {rate} tx/s"}
+        mix = q.get("mix", "payments")
+        if mix not in ("payments", "full"):
+            return {"status": "error", "detail": f"unknown mix {mix!r}"}
+        self.app.load_generator.generate_load(
+            self.app, accounts, txs, rate, mix=mix
+        )
+        return {
+            "status": f"Generating load: {accounts} accounts, {txs} txs,"
+            f" {rate} tx/s ({mix} mix)"
+        }

@@ -26,11 +26,21 @@ MIN_ACCOUNT_BALANCE = 1_000_000_000  # fund enough for many fees
 @dataclass
 class TestAccount:
     """A synthetic account with local sequence tracking
-    (LoadGenerator.h TestAccount)."""
+    (LoadGenerator.h TestAccount/AccountInfo).  Every account can issue
+    its own 4-char credit, like the reference's issuer/trustline graph."""
 
     key: SecretKey
+    idx: int = 0
     seq: int = 0
     created: bool = False
+    trustlines: list = None  # issuer idx list (reference mTrustLines)
+    offers: int = 0
+
+    def asset(self):
+        from ..xdr import entries as E
+
+        code = b"L%03d" % (self.idx % 1000)
+        return E.Asset.alphanum4(code, self.key.get_public_key())
 
 
 class LoadGenerator:
@@ -42,6 +52,7 @@ class LoadGenerator:
         self.pending_txs = 0
         self.rate = 10
         self.auto_rate = False
+        self.mix = "payments"
         self._last_second = -1
         self._root_seq = 0
         self._running = False
@@ -49,18 +60,24 @@ class LoadGenerator:
     # -- public api ---------------------------------------------------------
     def generate_load(
         self, app, n_accounts: int, n_txs: int, rate: int,
-        auto_rate: bool = False,
+        auto_rate: bool = False, mix: str = "payments",
     ) -> None:
         """(CommandHandler 'generateload') queue work and start stepping.
 
         ``auto_rate`` enables the reference's auto-calibration
         (LoadGenerator.cpp:334-402, the [autoload] mode): once a second
         the target rate adjusts toward the point where the mean ledger
-        close time sits at half the close cadence."""
+        close time sits at half the close cadence.
+
+        ``mix='full'`` adds the reference's richer random-tx shapes
+        (LoadGenerator.cpp:664-684 createRandomTransaction): trustline
+        creation, credit payments along trustlines, and market-maker
+        offers, alongside native payments."""
         self.pending_accounts += n_accounts
         self.pending_txs += n_txs
         self.rate = max(1, rate)
         self.auto_rate = auto_rate
+        self.mix = mix
         if not self._running:
             self._running = True
             if self.timer is None:
@@ -128,7 +145,7 @@ class LoadGenerator:
             submitted += 1
             self.pending_accounts -= 1
         while submitted < budget and self.pending_txs > 0 and self._have_live_accounts():
-            if not self._submit_payment(app):
+            if not self._submit_random_tx(app):
                 break
             submitted += 1
             self.pending_txs -= 1
@@ -164,7 +181,8 @@ class LoadGenerator:
 
         root = self._root(app)
         acct = TestAccount(
-            SecretKey.pseudo_random_for_testing(5000 + len(self.accounts))
+            SecretKey.pseudo_random_for_testing(5000 + len(self.accounts)),
+            idx=len(self.accounts),
         )
         self._root_seq += 1
         tx = T.tx_from_ops(
@@ -180,17 +198,131 @@ class LoadGenerator:
         self.accounts.append(acct)
         return True
 
+    def _submit_random_tx(self, app) -> bool:
+        """Pick a tx shape per the configured mix; anything whose
+        preconditions don't hold falls back to a native payment
+        (reference createRandomTransaction)."""
+        if self.mix == "full":
+            r = self._rng.random()
+            if r < 0.15 and self._submit_trust(app):
+                return True
+            if r < 0.30 and self._submit_credit_payment(app):
+                return True
+            if r < 0.40 and self._submit_offer(app):
+                return True
+        return self._submit_payment(app)
+
+    def _load_seq(self, app, acct) -> bool:
+        from ..ledger.accountframe import AccountFrame
+
+        if acct.seq == 0:
+            frame = AccountFrame.load_account(
+                acct.key.get_public_key(), app.database
+            )
+            if frame is None:
+                return False
+            acct.seq = frame.get_seq_num()
+        return True
+
+    def _submit_trust(self, app) -> bool:
+        """A random live account opens a trustline to another live
+        account's credit (reference createEstablishTrustTransaction)."""
+        from ..tx import testutils as T
+
+        live = [a for a in self.accounts if a.created]
+        if len(live) < 2:
+            return False
+        truster, issuer = self._rng.sample(live, 2)
+        if truster.trustlines is None:
+            truster.trustlines = []
+        if issuer.idx in truster.trustlines or not self._load_seq(app, truster):
+            return False
+        truster.seq += 1
+        tx = T.tx_from_ops(
+            app,
+            truster.key,
+            truster.seq,
+            [T.change_trust_op(issuer.asset(), 10**15)],
+        )
+        if not self._submit(app, tx):
+            truster.seq -= 1
+            return False
+        truster.trustlines.append(issuer.idx)
+        return True
+
+    def _trust_pairs(self):
+        # idx is the account's position in self.accounts by construction
+        return [
+            (a, self.accounts[i])
+            for a in self.accounts
+            if a.created and a.trustlines
+            for i in a.trustlines
+            if i < len(self.accounts) and self.accounts[i].created
+        ]
+
+    def _submit_credit_payment(self, app) -> bool:
+        """An issuer pays its own credit to an account trusting it
+        (reference createTransferCreditTransaction)."""
+        from ..tx import testutils as T
+
+        pairs = self._trust_pairs()
+        if not pairs:
+            return False
+        truster, issuer = self._rng.choice(pairs)
+        if not self._load_seq(app, issuer):
+            return False
+        issuer.seq += 1
+        amount = self._rng.randint(10, 10_000)
+        tx = T.tx_from_ops(
+            app,
+            issuer.key,
+            issuer.seq,
+            [T.payment_op(truster.key, amount, asset=issuer.asset())],
+        )
+        if not self._submit(app, tx):
+            issuer.seq -= 1
+            return False
+        return True
+
+    def _submit_offer(self, app) -> bool:
+        """An account holding a trustline market-makes: sells native for
+        the credit it trusts (reference createMarketMakingTransaction)."""
+        from ..tx import testutils as T
+        from ..xdr import entries as E
+
+        pairs = self._trust_pairs()
+        if not pairs:
+            return False
+        truster, issuer = self._rng.choice(pairs)
+        if not self._load_seq(app, truster):
+            return False
+        truster.seq += 1
+        tx = T.tx_from_ops(
+            app,
+            truster.key,
+            truster.seq,
+            [
+                T.manage_offer_op(
+                    E.Asset.native(),
+                    issuer.asset(),
+                    self._rng.randint(10, 1000),
+                    E.Price(1, 1),
+                )
+            ],
+        )
+        if not self._submit(app, tx):
+            truster.seq -= 1
+            return False
+        truster.offers += 1
+        return True
+
     def _submit_payment(self, app) -> bool:
         from ..tx import testutils as T
-        from ..ledger.accountframe import AccountFrame
 
         live = [a for a in self.accounts if a.created]
         src, dst = self._rng.sample(live, 2)
-        if src.seq == 0:
-            frame = AccountFrame.load_account(src.key.get_public_key(), app.database)
-            if frame is None:
-                return False  # not applied yet; retry never — skip
-            src.seq = frame.get_seq_num()
+        if not self._load_seq(app, src):
+            return False  # not applied yet; retry never — skip
         src.seq += 1
         amount = self._rng.randint(10, 10_000)
         tx = T.tx_from_ops(

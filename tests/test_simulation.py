@@ -156,3 +156,37 @@ def test_tcp_consensus_under_load():
     assert sim.crank_until(lambda: sim.have_all_externalized(15), 300)
     assert sim.all_ledgers_agree()
     sim.stop_all_nodes()
+
+
+def test_full_mix_load_trust_offers():
+    """mix='full' (reference createRandomTransaction shapes,
+    LoadGenerator.cpp:664-684): trustlines, credit payments, and offers
+    land in the DB alongside native payments; the node stays synced."""
+    from stellar_tpu.main.application import Application
+    from stellar_tpu.simulation.loadgen import LoadGenerator
+    from stellar_tpu.tx import testutils as T
+    from stellar_tpu.util.clock import VirtualClock
+
+    clock = VirtualClock()
+    cfg = T.get_test_config(61)
+    cfg.MANUAL_CLOSE = False
+    app = Application.create(clock, cfg, new_db=True)
+    app.herder.bootstrap()
+
+    lg = LoadGenerator(seed=4242)
+    lg.generate_load(app, 8, 120, rate=60, mix="full")
+    ok = clock.crank_until(lambda: lg.is_done(), 300)
+    assert ok, "full-mix load did not complete"
+    # let the last ledger close so everything applies
+    target = app.ledger_manager.get_last_closed_ledger_num() + 1
+    assert clock.crank_until(
+        lambda: app.ledger_manager.get_last_closed_ledger_num() >= target, 30
+    )
+    db = app.database
+    n_trust = db.query_one("SELECT count(*) FROM trustlines")[0]
+    n_offers = db.query_one("SELECT count(*) FROM offers")[0]
+    assert n_trust > 0, "full mix must create trustlines"
+    assert n_offers > 0, "full mix must create offers"
+    assert app.ledger_manager.is_synced()
+    app.graceful_stop()
+    clock.shutdown()
