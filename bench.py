@@ -16,7 +16,39 @@ Prints exactly ONE JSON line.
 import json
 import os
 import sys
+import threading
 import time
+
+_progress = {"stage": "start"}
+_done = threading.Event()
+
+
+def _arm_watchdog(seconds: float):
+    """A hung TPU relay blocks RPCs indefinitely (observed: backend setup
+    errors where even retries never return).  The driver must ALWAYS get
+    one JSON line, so a watchdog prints whatever was measured so far and
+    hard-exits."""
+
+    def fire():
+        if _done.is_set():
+            return  # normal completion won the race; one JSON line only
+        out = {
+            "metric": "ed25519_verifies_per_sec",
+            "value": _progress.get("rate", 0.0),
+            "unit": "verifies/sec",
+            "vs_baseline": round(_progress.get("rate", 0.0) / 200_000.0, 3),
+            "watchdog": f"fired after {seconds:.0f}s at stage "
+            f"{_progress.get('stage')!r} (TPU relay hang?)",
+        }
+        if "libsodium" in _progress:
+            out["libsodium_single_core_per_sec"] = _progress["libsodium"]
+        print(json.dumps(out), flush=True)
+        os._exit(2)
+
+    t = threading.Timer(seconds, fire)
+    t.daemon = True
+    t.start()
+    return t
 
 
 def _retry(fn, attempts=3, wait=20.0, tag=""):
@@ -59,6 +91,7 @@ def main():
     # BENCH_SLOW_RETRY times so a transient window doesn't define the round.
     slow_retries = int(os.environ.get("BENCH_SLOW_RETRY", "2"))
     good_rate = float(os.environ.get("BENCH_GOOD_RATE", "110000"))
+    watchdog = _arm_watchdog(float(os.environ.get("BENCH_WATCHDOG", "1500")))
 
     from stellar_tpu.crypto import SecretKey
     from stellar_tpu.ops.ed25519 import BatchVerifier
@@ -71,6 +104,7 @@ def main():
         items.append((sk.public_raw, msg, sk.sign(msg)))
 
     cpu_rate = bench_libsodium_single_core(items, seconds=1.0)
+    _progress.update(stage="warmup", libsodium=round(cpu_rate, 1))
 
     # nchunks chunks of `batch` pipeline through the verifier per call:
     # host staging/hash of chunk k+1 overlaps device compute of chunk k
@@ -81,13 +115,14 @@ def main():
     assert all(out), "benchmark signatures must all verify"
 
     def measure(k):
-        best = 0.0
+        best = _progress.get("rate", 0.0)
         for _ in range(k):
             t0 = time.perf_counter()
             out = _retry(lambda: bv.verify(items), tag="verify pass")
             dt = time.perf_counter() - t0
             assert all(out)
             best = max(best, len(items) / dt)
+            _progress.update(stage="measuring", rate=best)
         return best
 
     best = measure(iters)
@@ -115,6 +150,7 @@ def main():
         "speedup_vs_libsodium_core": round(rate / cpu_rate, 2),
         "device": _device_kind(),
     }
+    _progress.update(stage="ledger-close", rate=rate)
     if os.environ.get("BENCH_SKIP_CLOSE", "0") != "1":
         try:
             result.update(
@@ -125,6 +161,8 @@ def main():
             )
         except Exception as e:  # the verify headline must still be reported
             result["ledger_close_error"] = str(e)[:200]
+    _done.set()
+    watchdog.cancel()
     print(json.dumps(result))
 
 

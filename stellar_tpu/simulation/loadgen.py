@@ -33,7 +33,8 @@ class TestAccount:
     idx: int = 0
     seq: int = 0
     created: bool = False
-    trustlines: list = None  # issuer idx list (reference mTrustLines)
+    # issuer idx list (reference mTrustLines)
+    trustlines: list = field(default_factory=list)
     offers: int = 0
 
     def asset(self):
@@ -233,8 +234,6 @@ class LoadGenerator:
         if len(live) < 2:
             return False
         truster, issuer = self._rng.sample(live, 2)
-        if truster.trustlines is None:
-            truster.trustlines = []
         if issuer.idx in truster.trustlines or not self._load_seq(app, truster):
             return False
         truster.seq += 1

@@ -173,20 +173,23 @@ def test_full_mix_load_trust_offers():
     app = Application.create(clock, cfg, new_db=True)
     app.herder.bootstrap()
 
-    lg = LoadGenerator(seed=4242)
-    lg.generate_load(app, 8, 120, rate=60, mix="full")
-    ok = clock.crank_until(lambda: lg.is_done(), 300)
-    assert ok, "full-mix load did not complete"
-    # let the last ledger close so everything applies
-    target = app.ledger_manager.get_last_closed_ledger_num() + 1
-    assert clock.crank_until(
-        lambda: app.ledger_manager.get_last_closed_ledger_num() >= target, 30
-    )
-    db = app.database
-    n_trust = db.query_one("SELECT count(*) FROM trustlines")[0]
-    n_offers = db.query_one("SELECT count(*) FROM offers")[0]
-    assert n_trust > 0, "full mix must create trustlines"
-    assert n_offers > 0, "full mix must create offers"
-    assert app.ledger_manager.is_synced()
-    app.graceful_stop()
-    clock.shutdown()
+    try:
+        lg = LoadGenerator(seed=4242)
+        lg.generate_load(app, 8, 120, rate=60, mix="full")
+        ok = clock.crank_until(lambda: lg.is_done(), 300)
+        assert ok, "full-mix load did not complete"
+        # let the last ledger close so everything applies
+        target = app.ledger_manager.get_last_closed_ledger_num() + 1
+        assert clock.crank_until(
+            lambda: app.ledger_manager.get_last_closed_ledger_num() >= target,
+            30,
+        )
+        db = app.database
+        n_trust = db.query_one("SELECT count(*) FROM trustlines")[0]
+        n_offers = db.query_one("SELECT count(*) FROM offers")[0]
+        assert n_trust > 0, "full mix must create trustlines"
+        assert n_offers > 0, "full mix must create offers"
+        assert app.ledger_manager.is_synced()
+    finally:
+        app.graceful_stop()
+        clock.shutdown()
