@@ -94,7 +94,6 @@ def main():
     watchdog = _arm_watchdog(float(os.environ.get("BENCH_WATCHDOG", "1500")))
 
     from stellar_tpu.crypto import SecretKey
-    from stellar_tpu.ops.ed25519 import BatchVerifier
 
     # distinct key/message/signature triples
     items = []
@@ -104,7 +103,13 @@ def main():
         items.append((sk.public_raw, msg, sk.sign(msg)))
 
     cpu_rate = bench_libsodium_single_core(items, seconds=1.0)
-    _progress.update(stage="warmup", libsodium=round(cpu_rate, 1))
+    # the ops import touches the JAX backend — on a dead relay THIS is
+    # where the process wedges, so the CPU baseline is measured first and
+    # the watchdog line can carry it
+    _progress.update(stage="tpu-init", libsodium=round(cpu_rate, 1))
+    from stellar_tpu.ops.ed25519 import BatchVerifier
+
+    _progress.update(stage="warmup")
 
     # nchunks chunks of `batch` pipeline through the verifier per call:
     # host staging/hash of chunk k+1 overlaps device compute of chunk k
