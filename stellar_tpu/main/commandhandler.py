@@ -292,8 +292,8 @@ class CommandHandler:
 
         if q.get("queue") == "true":
             count = int(q.get("count", 50000))
-            ExternalQueue(self.app.database).delete_old_entries(count)
-            return {"status": "done"}
+            cmin = ExternalQueue(self.app.database).process(self.app, count)
+            return {"status": "done", "trimmed_through": cmin}
         return {"status": "No work performed"}
 
     def handle_dropcursor(self, q: dict) -> dict:

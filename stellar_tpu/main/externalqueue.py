@@ -1,8 +1,10 @@
 """ExternalQueue: pubsub cursors gating maintenance deletion
 (reference: src/main/ExternalQueue.*).
 
-External consumers (a Horizon-alike) register a cursor; ``maintenance`` may
-only delete tx history at/below the minimum cursor.
+External consumers (a Horizon-alike) register a cursor; ``maintenance``
+(``process``) trims ledger headers AND tx history at/below the lesser of
+the minimum cursor and what history publishing still needs (one full
+checkpoint before the publish point), via LedgerManager.delete_old_entries.
 """
 
 from __future__ import annotations
@@ -53,18 +55,28 @@ class ExternalQueue:
         row = self._db.query_one("SELECT MIN(lastread) FROM pubsub")
         return row[0] if row and row[0] is not None else None
 
-    def delete_old_entries(self, count: int) -> None:
-        """Trim tx history at/below the min cursor (maintenance endpoint)."""
-        m = self.min_cursor()
-        if m is None:
-            return
-        self._db.execute(
-            "DELETE FROM txhistory WHERE ledgerseq <= ? AND ledgerseq IN "
-            "(SELECT DISTINCT ledgerseq FROM txhistory ORDER BY ledgerseq LIMIT ?)",
-            (m, count),
-        )
-        self._db.execute(
-            "DELETE FROM txfeehistory WHERE ledgerseq <= ? AND ledgerseq IN "
-            "(SELECT DISTINCT ledgerseq FROM txfeehistory ORDER BY ledgerseq LIMIT ?)",
-            (m, count),
-        )
+    def process(self, app, count: int = 50000) -> int:
+        """Trim ledger headers + tx history at/below cmin, the lesser of
+        what remote subscribers still need (min cursor; maxint with no
+        subscribers) and what history publishing still needs — one full
+        checkpoint before min(queued-to-publish, LCL).  Work per call is
+        bounded: at most ``count`` ledgers past the oldest retained one
+        are trimmed, so a huge backlog drains over repeated maintenance
+        calls instead of one blocking DELETE.  Returns the effective
+        trim point.  (reference: ExternalQueue::process,
+        ExternalQueue.cpp:98-144.)"""
+        from ..ledger.manager import LedgerManager
+
+        rmin = self.min_cursor()
+        rmin = 0xFFFFFFFF if rmin is None else rmin
+        lcl = app.ledger_manager.get_last_closed_ledger_num()
+        ql = app.history_manager.get_min_ledger_queued_to_publish()
+        qmin = lcl if ql == 0 else min(ql, lcl)
+        freq = app.history_manager.checkpoint_frequency
+        lmin = qmin - freq if qmin >= freq else 0
+        cmin = min(lmin, rmin)
+        row = self._db.query_one("SELECT MIN(ledgerseq) FROM ledgerheaders")
+        if row and row[0] is not None:
+            cmin = min(cmin, row[0] + max(1, count) - 1)
+        LedgerManager.delete_old_entries(self._db, cmin)
+        return cmin

@@ -161,3 +161,44 @@ def test_profiler_route(app, tmp_path):
     assert os.path.isdir(d) and os.listdir(d), "trace dir must be written"
     assert "error" in ch.handle_profiler({"action": "stop"})  # not running
     assert "error" in ch.handle_profiler({})  # bad action
+
+
+def test_maintenance_queue_processing(app):
+    """HerderTests.cpp:103-147 'Queue processing': pubsub cursors gate
+    maintenance deletion of old ledger headers; the min across cursors
+    (and the publish checkpoint window) controls what is trimmed."""
+    from stellar_tpu.ledger.headerframe import LedgerHeaderFrame
+
+    ch = app.command_handler
+    lm = app.ledger_manager
+    # close ledgers past a checkpoint window so the publish bound allows
+    # deletion up to the cursors
+    freq = app.history_manager.checkpoint_frequency
+    while lm.get_last_closed_ledger_num() < freq + 5:
+        target = lm.get_last_closed_ledger_num() + 1
+        app.herder.trigger_next_ledger(lm.get_ledger_num())
+        assert app.clock.crank_until(
+            lambda: lm.get_last_closed_ledger_num() >= target, 30
+        )
+        # closeTime advances +1s per close; keep the virtual clock in step
+        # or our own MAX_TIME_SLIP check rejects the 61st+ value (the
+        # reference's crank(true) cadence advances time the same way)
+        app.clock.crank_for(1.0)
+
+    db = app.database
+    ch.execute("setcursor?id=A1&cursor=1")
+    ch.execute("maintenance?queue=true")
+    ch.execute("setcursor?id=A2&cursor=3")
+    ch.execute("maintenance?queue=true")
+    # min cursor is 1: header 2 must survive
+    assert LedgerHeaderFrame.load_by_sequence(db, 2) is not None
+
+    ch.execute("setcursor?id=A1&cursor=2")
+    ch.execute("maintenance?queue=true")  # deletes <= 2
+    assert LedgerHeaderFrame.load_by_sequence(db, 2) is None
+    assert LedgerHeaderFrame.load_by_sequence(db, 3) is not None
+
+    # min to 3 by dropping the lower cursor
+    ch.execute("dropcursor?id=A1")
+    ch.execute("maintenance?queue=true")  # min now A2=3
+    assert LedgerHeaderFrame.load_by_sequence(db, 3) is None
