@@ -171,8 +171,7 @@ class HistoryManager:
     def get_min_ledger_queued_to_publish(self) -> int:
         """Smallest queued-but-unpublished checkpoint ledger, 0 if none
         (reference: getMinLedgerQueuedToPublish, gates maintenance)."""
-        queued = publish_queue.queued_checkpoints(self.app.database)
-        return queued[0][0] if queued else 0
+        return publish_queue.min_queued(self.app.database)
 
     def get_publish_success_count(self) -> int:
         return self._publish_success

@@ -29,5 +29,12 @@ def queued_checkpoints(db) -> List[tuple]:
     return db.query_all("SELECT ledger, state FROM publishqueue ORDER BY ledger")
 
 
+def min_queued(db) -> int:
+    """Smallest queued checkpoint ledger, 0 if none (avoids pulling the
+    archive-state blobs just to read a number)."""
+    row = db.query_one("SELECT MIN(ledger) FROM publishqueue")
+    return row[0] if row and row[0] is not None else 0
+
+
 def dequeue_checkpoint(db, ledger_seq: int) -> None:
     db.execute("DELETE FROM publishqueue WHERE ledger=?", (ledger_seq,))

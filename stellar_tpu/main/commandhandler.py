@@ -292,7 +292,7 @@ class CommandHandler:
 
         if q.get("queue") == "true":
             count = int(q.get("count", 50000))
-            cmin = ExternalQueue(self.app.database).process(self.app, count)
+            cmin = ExternalQueue(self.app).process(count)
             return {"status": "done", "trimmed_through": cmin}
         return {"status": "No work performed"}
 

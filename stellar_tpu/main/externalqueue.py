@@ -17,6 +17,7 @@ class ExternalQueue:
     _VALID = re.compile(r"^[A-Z][A-Z0-9]{0,31}$")
 
     def __init__(self, app_or_db):
+        self._app = app_or_db if hasattr(app_or_db, "database") else None
         self._db = getattr(app_or_db, "database", app_or_db)
 
     @staticmethod
@@ -55,7 +56,7 @@ class ExternalQueue:
         row = self._db.query_one("SELECT MIN(lastread) FROM pubsub")
         return row[0] if row and row[0] is not None else None
 
-    def process(self, app, count: int = 50000) -> int:
+    def process(self, count: int = 50000) -> int:
         """Trim ledger headers + tx history at/below cmin, the lesser of
         what remote subscribers still need (min cursor; maxint with no
         subscribers) and what history publishing still needs — one full
@@ -67,6 +68,9 @@ class ExternalQueue:
         ExternalQueue.cpp:98-144.)"""
         from ..ledger.manager import LedgerManager
 
+        app = self._app
+        if app is None:
+            raise RuntimeError("process() needs an ExternalQueue(app)")
         rmin = self.min_cursor()
         rmin = 0xFFFFFFFF if rmin is None else rmin
         lcl = app.ledger_manager.get_last_closed_ledger_num()

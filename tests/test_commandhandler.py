@@ -163,12 +163,20 @@ def test_profiler_route(app, tmp_path):
     assert "error" in ch.handle_profiler({})  # bad action
 
 
-def test_maintenance_queue_processing(app):
+def test_maintenance_queue_processing():
     """HerderTests.cpp:103-147 'Queue processing': pubsub cursors gate
     maintenance deletion of old ledger headers; the min across cursors
-    (and the publish checkpoint window) controls what is trimmed."""
+    (and the publish checkpoint window) controls what is trimmed.  A
+    small CHECKPOINT_FREQUENCY keeps the consensus rounds cheap."""
     from stellar_tpu.ledger.headerframe import LedgerHeaderFrame
 
+    clock = VirtualClock(VIRTUAL_TIME)
+    cfg = T.get_test_config(85)
+    cfg.MANUAL_CLOSE = True
+    cfg.HTTP_PORT = 0
+    cfg.CHECKPOINT_FREQUENCY = 8
+    app = Application.create(clock, cfg, new_db=True)
+    app.start()
     ch = app.command_handler
     lm = app.ledger_manager
     # close ledgers past a checkpoint window so the publish bound allows
@@ -181,8 +189,7 @@ def test_maintenance_queue_processing(app):
             lambda: lm.get_last_closed_ledger_num() >= target, 30
         )
         # closeTime advances +1s per close; keep the virtual clock in step
-        # or our own MAX_TIME_SLIP check rejects the 61st+ value (the
-        # reference's crank(true) cadence advances time the same way)
+        # (the reference's crank(true) cadence advances time the same way)
         app.clock.crank_for(1.0)
 
     db = app.database
@@ -202,3 +209,5 @@ def test_maintenance_queue_processing(app):
     ch.execute("dropcursor?id=A1")
     ch.execute("maintenance?queue=true")  # min now A2=3
     assert LedgerHeaderFrame.load_by_sequence(db, 3) is None
+    app.graceful_stop()
+    clock.shutdown()

@@ -165,3 +165,117 @@ class TestTxQueueAging:
         for gen in h.received_transactions:
             assert acc not in gen
         assert load_or_none(app, dest) is None
+
+
+class TestTxSetValidity:
+    """Ported from the reference's 'txset' case (HerderTests.cpp:162-316):
+    one funded source account, 2 destination chains x 5 txs; each section
+    perturbs the set, asserts check_valid flips false, and trim_invalid
+    restores validity."""
+
+    def _world(self, clock):
+        from stellar_tpu.herder.txset import TxSetFrame
+        from stellar_tpu.ledger.accountframe import AccountFrame
+
+        cfg = T.get_test_config(75)
+        cfg.MANUAL_CLOSE = True
+        app = Application.create(clock, cfg, new_db=True)
+        app.start()
+        lm = app.ledger_manager
+        root = T.root_key_for(app)
+        n_accounts, n_txs = 2, 5
+        payment = lm.get_min_balance(0)
+        source = T.get_account("source")
+        fund = n_accounts * n_txs * lm.get_tx_fee() + payment
+        root_seq = AccountFrame.load_account(
+            root.get_public_key(), app.database
+        ).get_seq_num()
+        T.apply_tx(
+            app,
+            T.tx_from_ops(
+                app, root, root_seq + 1, [T.create_account_op(source, fund)]
+            ),
+        )
+        seq = AccountFrame.load_account(
+            source.get_public_key(), app.database
+        ).get_seq_num()
+        txs = []
+        for i in range(n_accounts):
+            dest = T.get_account(f"A{i}")
+            for j in range(n_txs):
+                seq += 1
+                op = (
+                    T.create_account_op(dest, payment)
+                    if j == 0
+                    else T.payment_op(dest, payment)
+                )
+                txs.append(T.tx_from_ops(app, source, seq, [op]))
+        ts = TxSetFrame(lm.last_closed.hash, txs)
+        return app, ts, source, seq, payment
+
+    def _check_trim_restores(self, app, ts):
+        assert not ts.check_valid(app)
+        ts.trim_invalid(app)
+        assert ts.check_valid(app)
+
+    def test_success_and_trim_noop(self, clock):
+        app, ts, *_ = self._world(clock)
+        ts.sort_for_hash()
+        assert ts.check_valid(app)
+        assert ts.trim_invalid(app) == []
+        assert ts.check_valid(app)
+        app.graceful_stop()
+
+    def test_out_of_hash_order(self, clock):
+        app, ts, *_ = self._world(clock)
+        ts.sort_for_hash()
+        ts.transactions[0], ts.transactions[1] = (
+            ts.transactions[1],
+            ts.transactions[0],
+        )
+        assert not ts.check_valid(app)
+        ts.sort_for_hash()
+        assert ts.check_valid(app)
+        app.graceful_stop()
+
+    def test_no_user(self, clock):
+        """A tx from a nonexistent account invalidates the set; trim fixes."""
+        app, ts, *_ = self._world(clock)
+        ghost = T.get_account("ghost")
+        ts.add_transaction(
+            T.tx_from_ops(app, ghost, (2 << 32) + 1, [T.payment_op(ghost, 1)])
+        )
+        ts.sort_for_hash()
+        self._check_trim_restores(app, ts)
+        app.graceful_stop()
+
+    @pytest.mark.parametrize("where", ["begin", "middle", "after"])
+    def test_sequence_gap(self, clock, where):
+        app, ts, source, seq, payment = self._world(clock)
+        if where == "after":
+            ts.add_transaction(
+                T.tx_from_ops(
+                    app, source, seq + 5, [T.payment_op(source, payment)]
+                )
+            )
+        else:
+            # drop one tx of the source's chain to open a gap
+            drop = 0 if where == "begin" else 3
+            chain = sorted(ts.transactions, key=lambda t: t.get_seq_num())
+            ts.remove_tx(chain[drop])
+        ts.sort_for_hash()
+        self._check_trim_restores(app, ts)
+        app.graceful_stop()
+
+    def test_insufficient_balance(self, clock):
+        """One extra tx pushes the source below reserve for the whole set:
+        the reference drops the entire account group."""
+        app, ts, source, seq, payment = self._world(clock)
+        ts.add_transaction(
+            T.tx_from_ops(
+                app, source, seq + 1, [T.payment_op(source, payment)]
+            )
+        )
+        ts.sort_for_hash()
+        self._check_trim_restores(app, ts)
+        app.graceful_stop()
