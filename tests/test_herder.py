@@ -279,3 +279,126 @@ class TestTxSetValidity:
         ts.sort_for_hash()
         self._check_trim_restores(app, ts)
         app.graceful_stop()
+
+
+class TestSurgePricing:
+    """Ported from the reference's 'surge' case (HerderTests.cpp:320-490):
+    DESIRED_MAX_TX_PER_LEDGER=5, competing accounts, the filter keeps the
+    5 best-paying txs and the result stays valid."""
+
+    def _world(self, clock):
+        from stellar_tpu.herder.txset import TxSetFrame
+
+        cfg = T.get_test_config(76)
+        cfg.MANUAL_CLOSE = True
+        app = Application.create(clock, cfg, new_db=True)
+        app.start()
+        # the filter reads the current header's maxTxSetSize directly
+        app.ledger_manager.current.header.maxTxSetSize = 5
+        root = T.root_key_for(app)
+        root_seq = AccountFrame.load_account(
+            root.get_public_key(), app.database
+        ).get_seq_num()
+        dest = T.get_account("destAccount")
+        accs = {}
+        for name in ("accountB", "accountC"):
+            accs[name] = T.get_account(name)
+            root_seq += 1
+            T.apply_tx(
+                app,
+                T.tx_from_ops(
+                    app,
+                    root,
+                    root_seq,
+                    [T.create_account_op(accs[name], 5_000_000_000)],
+                ),
+            )
+        seqs = {
+            "root": root_seq,
+            "accountB": AccountFrame.load_account(
+                accs["accountB"].get_public_key(), app.database
+            ).get_seq_num(),
+            "accountC": AccountFrame.load_account(
+                accs["accountC"].get_public_key(), app.database
+            ).get_seq_num(),
+        }
+        keys = {"root": root, **accs}
+        ts = TxSetFrame(app.ledger_manager.last_closed.hash, [])
+        return app, ts, keys, seqs, dest
+
+    def _pay(self, app, ts, keys, seqs, who, dest, amount, fee_mult=1):
+        seqs[who] += 1
+        fee = app.ledger_manager.get_tx_fee() * fee_mult
+        ts.add_transaction(
+            T.tx_from_ops(
+                app, keys[who], seqs[who], [T.payment_op(dest, amount)],
+                fee=fee,
+            )
+        )
+
+    def test_over_surge(self, clock):
+        app, ts, keys, seqs, dest = self._world(clock)
+        for n in range(10):
+            self._pay(app, ts, keys, seqs, "root", dest, n + 10)
+        ts.sort_for_hash()
+        ts.surge_pricing_filter(app.ledger_manager)
+        assert len(ts.transactions) == 5
+        assert ts.check_valid(app)
+        app.graceful_stop()
+
+    def test_over_surge_shuffled(self, clock):
+        import random as _r
+
+        app, ts, keys, seqs, dest = self._world(clock)
+        for n in range(10):
+            self._pay(app, ts, keys, seqs, "root", dest, n + 10)
+        # filter the UNSORTED set: the result must not depend on input
+        # order (sorting first would make this identical to test_over_surge)
+        _r.Random(7).shuffle(ts.transactions)
+        ts.surge_pricing_filter(app.ledger_manager)
+        assert len(ts.transactions) == 5
+        ts.sort_for_hash()
+        assert ts.check_valid(app)
+        app.graceful_stop()
+
+    def test_one_account_paying_more(self, clock):
+        app, ts, keys, seqs, dest = self._world(clock)
+        for n in range(10):
+            self._pay(app, ts, keys, seqs, "root", dest, n + 10)
+            self._pay(app, ts, keys, seqs, "accountB", dest, n + 10, fee_mult=2)
+        ts.sort_for_hash()
+        ts.surge_pricing_filter(app.ledger_manager)
+        assert len(ts.transactions) == 5
+        assert ts.check_valid(app)
+        b_key = keys["accountB"].get_public_key()
+        assert all(tx.get_source_id() == b_key for tx in ts.transactions)
+        app.graceful_stop()
+
+    def test_one_account_paying_more_except_one_tx(self, clock):
+        """accountB pays 3x except one tx at 1x: the account's fee RATIO is
+        its minimum, so root (uniform 2x) wins the whole window."""
+        app, ts, keys, seqs, dest = self._world(clock)
+        for n in range(10):
+            self._pay(app, ts, keys, seqs, "root", dest, n + 10, fee_mult=2)
+            self._pay(
+                app, ts, keys, seqs, "accountB", dest, n + 10,
+                fee_mult=(3 if n != 1 else 1),
+            )
+        ts.sort_for_hash()
+        ts.surge_pricing_filter(app.ledger_manager)
+        assert len(ts.transactions) == 5
+        assert ts.check_valid(app)
+        root_key = keys["root"].get_public_key()
+        assert all(tx.get_source_id() == root_key for tx in ts.transactions)
+        app.graceful_stop()
+
+    def test_a_lot_of_txs(self, clock):
+        app, ts, keys, seqs, dest = self._world(clock)
+        for n in range(30):
+            for who in ("root", "accountB", "accountC"):
+                self._pay(app, ts, keys, seqs, who, dest, n + 10)
+        ts.sort_for_hash()
+        ts.surge_pricing_filter(app.ledger_manager)
+        assert len(ts.transactions) == 5
+        assert ts.check_valid(app)
+        app.graceful_stop()
