@@ -215,16 +215,27 @@ def _write_merged(
     objects = 0
     oi = _Peekable(old_it)
     ni = _Peekable(new_it)
+    buffered = None  # (identity, entry): one-entry dedup window
     with XDROutputFileStream(tmp, hasher=hasher) as out:
 
         def put(e: BucketEntry, identity) -> None:
-            nonlocal objects
+            """Buffer one entry so adjacent same-identity entries collapse
+            (last wins) — the reference's BucketOutputIterator::put does
+            the same, which is what makes a batch containing duplicates
+            hash identically to the deduplicated batch
+            (BucketTests.cpp:296 'duplicate bucket entries')."""
+            nonlocal buffered, objects
             if e.type == BucketEntryType.DEADENTRY and not keep_dead_entries:
                 return
             if _shadowed(identity, shadow_iters):
                 return
-            out.write_one(e)
-            objects += 1
+            if buffered is not None and buffered[0] == identity:
+                buffered = (identity, e)
+                return
+            if buffered is not None:
+                out.write_one(buffered[1])
+                objects += 1
+            buffered = (identity, e)
 
         while oi.head is not None or ni.head is not None:
             if ni.head is None:
@@ -243,6 +254,9 @@ def _write_merged(
                 put(ni.head[1], ni.head[0])
                 oi.advance()
                 ni.advance()
+        if buffered is not None:
+            out.write_one(buffered[1])
+            objects += 1
     if objects == 0:
         os.unlink(tmp)
         return Bucket()

@@ -269,7 +269,59 @@ typedef struct {
     int keep_dead;
     stream shadows[MAX_SHADOWS];
     int n_shadows;
+    /* one-entry dedup window: adjacent same-identity entries collapse,
+     * last wins — mirrors bucket.py _write_merged's buffered put (and the
+     * reference BucketOutputIterator), keeping both paths bit-identical
+     * even for inputs that contain duplicate identities */
+    unsigned char *pend;
+    size_t pend_cap;
+    size_t pend_len;
+    uint32_t pend_etype;
+    unsigned char pend_key[96]; /* max identity: trustline 36+52 = 88 */
+    size_t pend_keylen;
+    int pend_have;
 } writer;
+
+/* write one framed record + hash it */
+static int emit(writer *w, const unsigned char *body, size_t len) {
+    unsigned char hdr[4];
+    uint32_t framed = (uint32_t)len | 0x80000000u;
+    hdr[0] = (unsigned char)(framed >> 24);
+    hdr[1] = (unsigned char)(framed >> 16);
+    hdr[2] = (unsigned char)(framed >> 8);
+    hdr[3] = (unsigned char)framed;
+    if (fwrite(hdr, 1, 4, w->f) != 4) return -1;
+    if (fwrite(body, 1, len, w->f) != len) return -1;
+    sha256_update(&w->sha, hdr, 4);
+    sha256_update(&w->sha, body, len);
+    w->count++;
+    return 0;
+}
+
+static int flush_pending(writer *w) {
+    if (!w->pend_have) return 0;
+    w->pend_have = 0;
+    return emit(w, w->pend, w->pend_len);
+}
+
+/* stash the record as the pending entry (s->body is reused by the next
+ * stream_next, so copy) */
+static int buffer_rec(writer *w, const stream *s) {
+    if (s->keylen > sizeof w->pend_key) return -1;
+    if (s->len > w->pend_cap) {
+        unsigned char *nb = (unsigned char *)realloc(w->pend, s->len);
+        if (!nb) return -1;
+        w->pend = nb;
+        w->pend_cap = s->len;
+    }
+    memcpy(w->pend, s->body, s->len);
+    w->pend_len = s->len;
+    w->pend_etype = s->etype;
+    memcpy(w->pend_key, s->key, s->keylen);
+    w->pend_keylen = s->keylen;
+    w->pend_have = 1;
+    return 0;
+}
 
 /* 1 if the candidate identity appears in any shadow stream */
 static int shadowed(writer *w, const stream *cand) {
@@ -284,24 +336,20 @@ static int shadowed(writer *w, const stream *cand) {
 }
 
 static int put(writer *w, const stream *s) {
-    unsigned char hdr[4];
-    uint32_t framed;
     int sh;
     if (s->is_dead && !w->keep_dead) return 0;
     sh = shadowed(w, s);
     if (sh < 0) return -1;
     if (sh) return 0;
-    framed = (uint32_t)s->len | 0x80000000u;
-    hdr[0] = (unsigned char)(framed >> 24);
-    hdr[1] = (unsigned char)(framed >> 16);
-    hdr[2] = (unsigned char)(framed >> 8);
-    hdr[3] = (unsigned char)framed;
-    if (fwrite(hdr, 1, 4, w->f) != 4) return -1;
-    if (fwrite(s->body, 1, s->len, w->f) != s->len) return -1;
-    sha256_update(&w->sha, hdr, 4);
-    sha256_update(&w->sha, s->body, s->len);
-    w->count++;
-    return 0;
+    if (w->pend_have && w->pend_etype == s->etype &&
+        w->pend_keylen == s->keylen &&
+        memcmp(w->pend_key, s->key, s->keylen) == 0) {
+        /* same identity as the buffered entry: last wins */
+        w->pend_have = 0;
+        return buffer_rec(w, s);
+    }
+    if (flush_pending(w) != 0) return -1;
+    return buffer_rec(w, s);
 }
 
 int bucket_merge(const char *old_path, const char *new_path,
@@ -353,6 +401,7 @@ int bucket_merge(const char *old_path, const char *new_path,
             if (stream_next(&sn) < 0) goto done;
         }
     }
+    if (flush_pending(&w) != 0) goto done;
     sha256_final(&w.sha, out_hash);
     *out_count = w.count;
     rc = 0;
@@ -360,6 +409,7 @@ done:
     stream_close(&so);
     stream_close(&sn);
     for (i = 0; i < w.n_shadows; i++) stream_close(&w.shadows[i]);
+    free(w.pend);
     if (w.f) fclose(w.f);
     if (rc != 0) remove(out_path);
     return rc;

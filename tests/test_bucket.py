@@ -327,3 +327,54 @@ class TestSkipValues:
         finally:
             app.graceful_stop()
             clock.shutdown()
+
+
+def test_bucketmanager_ownership_gc(app):
+    """BucketTests.cpp:584-650 'bucketmanager ownership', in our
+    referenced-set design: a fresh bucket outside the bucket list is
+    GC'd (file deleted); bucket-list members survive; a replaced level-0
+    curr is collected on the next GC."""
+    bm = app.bucket_manager
+    live = [account_entry(i) for i in range(10)]
+
+    loose = Bucket.fresh(bm, live, [])
+    assert os.path.exists(loose.path)
+    bm.forget_unreferenced_buckets()
+    assert not os.path.exists(loose.path), "unreferenced bucket must be GC'd"
+    with pytest.raises(KeyError):
+        bm.get_bucket_by_hash(loose.get_hash())
+
+    # a bucket owned by the bucket list survives GC
+    bm.add_batch(1, live, [])
+    curr = bm.bucket_list.get_level(0).curr
+    assert curr.get_hash() != ZERO_HASH and os.path.exists(curr.path)
+    bm.forget_unreferenced_buckets()
+    assert os.path.exists(curr.path)
+    assert bm.get_bucket_by_hash(curr.get_hash()) is curr
+
+    # a replaced level-0 curr first survives as snap / merge input, then
+    # falls out of the referenced set as later ledgers spill past it
+    h0 = curr.get_hash()
+    for seq in range(2, 40):
+        live2 = [account_entry(i, balance=seq) for i in range(10)]
+        bm.add_batch(seq, live2, [])
+        for lev in bm.bucket_list.levels:
+            if lev.next.is_live():
+                lev.next.resolve()
+        bm.forget_unreferenced_buckets()
+        if not os.path.exists(curr.path):
+            break
+    assert h0 not in bm.referenced_hashes()
+    assert not os.path.exists(curr.path), "old curr must eventually be GC'd"
+
+
+def test_duplicate_entries_in_one_batch(app):
+    """BucketTests.cpp:296-338 'duplicate bucket entries': the same
+    identity twice in one batch collapses to a single (last-wins) entry."""
+    bm = app.bucket_manager
+    a_v1 = account_entry(1, balance=100)
+    a_v2 = account_entry(1, balance=777)
+    b = Bucket.fresh(bm, [a_v1, a_v2], [])
+    entries = list(b)
+    assert len(entries) == 1
+    assert entries[0].value.data.value.balance == 777

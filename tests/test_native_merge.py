@@ -126,3 +126,48 @@ def test_full_bucket_list_with_native_engine(app):
             ] = e
     final = replay_levels(bl)
     assert set(final) == set(expected)
+
+
+def test_native_merge_dedups_adjacent_duplicates(app, tmp_path):
+    """Both engines must collapse adjacent same-identity entries (last
+    wins) identically — a bucket file written by pre-dedup code, or a
+    hostile archive, may contain duplicates (BucketTests.cpp:296)."""
+    from stellar_tpu.util.xdrstream import XDROutputFileStream
+    from tests.test_bucket import account_entry
+
+    def write_raw(path, entries):
+        with XDROutputFileStream(path) as out:
+            for e in entries:
+                out.write_one(e)
+
+    # old: account 1 duplicated with different balances, then account 2
+    dup_v1 = BucketEntry(BucketEntryType.LIVEENTRY, account_entry(1, 100))
+    dup_v2 = BucketEntry(BucketEntryType.LIVEENTRY, account_entry(1, 777))
+    other = BucketEntry(BucketEntryType.LIVEENTRY, account_entry(2, 5))
+    entries = sorted([dup_v1, dup_v2, other], key=entry_identity)
+    old_path = str(tmp_path / "dup-old.bucket")
+    write_raw(old_path, entries)
+    import hashlib
+
+    h = hashlib.sha256(open(old_path, "rb").read()).digest()
+    old = Bucket(old_path, h)
+    new = Bucket.fresh(
+        app.bucket_manager, [account_entry(3, 9)], []
+    )
+
+    via_python = python_merge(app, old, new, [], True)
+    out_native = native.merge_files(
+        old.path, new.path, [], True,
+        str(tmp_path / "dup-out.bucket"),
+    )
+    assert out_native is not None
+    native_hash, native_count = out_native
+    assert native_count == 3  # accounts 1 (deduped), 2, 3
+    assert native_hash == via_python.get_hash()
+    # the surviving duplicate is the LAST one (balance 777)
+    kept = [
+        e.value.data.value.balance
+        for e in via_python
+        if e.value.data.value.accountID.value[:4] == (1).to_bytes(4, "big")
+    ]
+    assert kept == [777]
