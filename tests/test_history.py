@@ -317,3 +317,76 @@ def test_persist_publish_queue_across_restart(clock, fresh_archive, tmp_path):
     assert publish_queue.queued_checkpoints(app2.database) == []
     assert os.path.isdir(os.path.join(fresh_archive, "bucket"))
     app2.graceful_stop()
+
+
+def test_publish_catchup_alternation_with_stall(clock, fresh_archive, monkeypatch):
+    """HistoryTests.cpp:724-798 'Publish/catchup alternation, with stall':
+    two followers (COMPLETE and MINIMAL) alternate catching up with the
+    publisher; when the publisher closes past the last publish point
+    without publishing, catchup stalls (the archive is not ahead), and
+    completes again once the next checkpoint lands."""
+    from stellar_tpu.history import catchupsm
+
+    # the stall leg exhausts the retry loop; don't sleep 5x2s of real time
+    monkeypatch.setattr(catchupsm, "RETRY_DELAY_SECONDS", 0.05)
+    pub = make_app(clock, 30, fresh_archive, writable_archive=True)
+    followers = {}
+    try:
+        publish_checkpoint(pub, clock, accounts=True)
+
+        for inst, mode in ((31, "complete"), (32, "minimal")):
+            f = make_app(clock, inst, fresh_archive, writable_archive=False)
+            followers[mode] = f
+            f.ledger_manager.start_catchup(mode=mode)
+            assert clock.crank_until(
+                lambda f=f: f.ledger_manager.state
+                == LedgerState.LM_SYNCED_STATE,
+                60,
+            )
+            assert (
+                f.ledger_manager.last_closed.hash
+                == pub.ledger_manager.last_closed.hash
+            )
+
+        # alternate: publish another checkpoint, both catch up again
+        publish_checkpoint(pub, clock, accounts=True)
+        for mode, f in followers.items():
+            f.ledger_manager.start_catchup(mode=mode)
+            assert clock.crank_until(
+                lambda f=f: f.ledger_manager.state
+                == LedgerState.LM_SYNCED_STATE
+                and f.ledger_manager.last_closed.hash
+                == pub.ledger_manager.last_closed.hash,
+                60,
+            )
+
+        # publisher closes PAST the publish point but does not publish:
+        # followers' catchup must stall (fail after retries), not sync
+        for _ in range(3):
+            close_one(pub, clock, [])
+        f = followers["complete"]
+        f.ledger_manager.start_catchup(mode="complete")
+        # wait for the round to SETTLE either way, then require the stall —
+        # a wrong sync fails fast instead of timing out
+        assert clock.crank_until(
+            lambda: f.ledger_manager.state
+            in (LedgerState.LM_BOOTING_STATE, LedgerState.LM_SYNCED_STATE),
+            120,
+        )
+        assert f.ledger_manager.state == LedgerState.LM_BOOTING_STATE, (
+            "catchup against a stale archive must stall out"
+        )
+
+        # the next published checkpoint un-stalls it
+        publish_checkpoint(pub, clock, accounts=True)
+        f.ledger_manager.start_catchup(mode="complete")
+        assert clock.crank_until(
+            lambda: f.ledger_manager.state == LedgerState.LM_SYNCED_STATE
+            and f.ledger_manager.last_closed.hash
+            == pub.ledger_manager.last_closed.hash,
+            60,
+        )
+    finally:
+        pub.graceful_stop()
+        for f in followers.values():
+            f.graceful_stop()
