@@ -1,0 +1,64 @@
+"""bench.py contract tests: the driver consumes exactly one JSON line in
+every outcome (normal completion and watchdog-fired), on any backend."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_bench(env_extra, timeout=240):
+    # ambient BENCH_* knobs (from manual hardware runs) must not leak in
+    env = {k: v for k, v in os.environ.items() if not k.startswith("BENCH_")}
+    env.update(env_extra)
+    code = (
+        "import jax; jax.config.update('jax_platforms','cpu');"
+        "import bench; bench.main()"
+    )
+    return subprocess.run(
+        [sys.executable, "-c", code],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+    )
+
+
+def test_bench_emits_one_json_line():
+    r = run_bench(
+        {
+            "BENCH_BATCH": "128",
+            "BENCH_CHUNKS": "1",
+            "BENCH_ITERS": "1",
+            "BENCH_SKIP_CLOSE": "1",
+            "BENCH_GOOD_RATE": "1",  # CPU rates must not trigger slow-retry
+        }
+    )
+    assert r.returncode == 0, r.stderr[-500:]
+    lines = [l for l in r.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, r.stdout
+    out = json.loads(lines[0])
+    assert out["metric"] == "ed25519_verifies_per_sec"
+    assert out["value"] > 0
+    assert "watchdog" not in out
+
+
+def test_bench_watchdog_fires_with_partial_result():
+    r = run_bench(
+        {
+            "BENCH_BATCH": "2048",
+            "BENCH_CHUNKS": "4",
+            "BENCH_ITERS": "50",
+            "BENCH_SKIP_CLOSE": "1",
+            "BENCH_WATCHDOG": "3",
+        }
+    )
+    assert r.returncode == 2
+    lines = [l for l in r.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, r.stdout
+    out = json.loads(lines[0])
+    assert "watchdog" in out
+    assert out["metric"] == "ed25519_verifies_per_sec"
