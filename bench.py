@@ -15,12 +15,39 @@ Prints exactly ONE JSON line.
 
 import json
 import os
+import subprocess
 import sys
 import threading
 import time
 
 _progress = {"stage": "start"}
-_done = threading.Event()
+_t_start = time.monotonic()
+_emit_lock = threading.Lock()
+_emitted = False
+
+
+def _try_emit(extra: dict) -> bool:
+    """Print THE one JSON line every exit path shares: headline metric plus
+    whatever _progress has accumulated, merged with path-specific fields.
+    Atomic test-and-set — exactly one caller ever prints, even when the
+    watchdog timer thread races normal completion."""
+    global _emitted
+    with _emit_lock:
+        if _emitted:
+            return False
+        _emitted = True
+    rate = _progress.get("rate", 0.0)
+    out = {
+        "metric": "ed25519_verifies_per_sec",
+        "value": round(rate, 1),
+        "unit": "verifies/sec",
+        "vs_baseline": round(rate / 200_000.0, 3),
+    }
+    if "libsodium" in _progress:
+        out["libsodium_single_core_per_sec"] = _progress["libsodium"]
+    out.update(extra)
+    print(json.dumps(out), flush=True)
+    return True
 
 
 def _arm_watchdog(seconds: float):
@@ -30,20 +57,14 @@ def _arm_watchdog(seconds: float):
     hard-exits."""
 
     def fire():
-        if _done.is_set():
-            return  # normal completion won the race; one JSON line only
-        out = {
-            "metric": "ed25519_verifies_per_sec",
-            "value": _progress.get("rate", 0.0),
-            "unit": "verifies/sec",
-            "vs_baseline": round(_progress.get("rate", 0.0) / 200_000.0, 3),
-            "watchdog": f"fired after {seconds:.0f}s at stage "
-            f"{_progress.get('stage')!r} (TPU relay hang?)",
-        }
-        if "libsodium" in _progress:
-            out["libsodium_single_core_per_sec"] = _progress["libsodium"]
-        print(json.dumps(out), flush=True)
-        os._exit(2)
+        if _try_emit(
+            {
+                "watchdog": f"fired after {seconds:.0f}s at stage "
+                f"{_progress.get('stage')!r} (TPU relay hang?)"
+            }
+        ):
+            os._exit(2)
+        # else: normal completion won the race; one JSON line only
 
     t = threading.Timer(seconds, fire)
     t.daemon = True
@@ -69,6 +90,81 @@ def _retry(fn, attempts=3, wait=20.0, tag=""):
             time.sleep(wait)
 
 
+def _platform_forced_cpu() -> bool:
+    """True when this process will run jax on CPU (contract tests force it
+    via jax.config or JAX_PLATFORMS) — CPU backend init cannot hang, so the
+    relay probe would only add latency (and would itself latch the relay)."""
+    jx = sys.modules.get("jax")
+    if jx is not None:
+        try:
+            if jx.config.jax_platforms == "cpu":
+                return True
+        except Exception:
+            pass
+    return os.environ.get("JAX_PLATFORMS", "") == "cpu"
+
+
+def _probe_tpu_alive(timeout=90.0) -> bool:
+    """True iff a fresh child process can init the JAX backend and see a
+    device.  A dead axon relay makes backend init block FOREVER in-process
+    (observed r03: 4+ hour outage, watchdog fired at stage 'tpu-init' and
+    the round recorded 0.0) — so the probe runs in a killable subprocess,
+    never in the benchmark process itself."""
+    # the child honors JAX_PLATFORMS via an in-process config update: the
+    # environment's sitecustomize registers/latches its own platform before
+    # env vars are consulted, so env alone cannot redirect the child (the
+    # production env sets JAX_PLATFORMS=axon — the probe targets the relay)
+    code = (
+        "import os, jax\n"
+        "p = os.environ.get('JAX_PLATFORMS')\n"
+        "if p:\n"
+        "    jax.config.update('jax_platforms', p)\n"
+        "assert jax.devices()\n"
+        "print('ok')\n"
+    )
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", code],
+            timeout=timeout,
+            capture_output=True,
+            text=True,
+        )
+        return p.returncode == 0 and "ok" in p.stdout
+    except subprocess.TimeoutExpired:
+        return False
+    except Exception:
+        return False
+
+
+def _wait_for_tpu(deadline: float, probe_timeout=90.0, pause=45.0) -> bool:
+    """Probe the relay in killable children until one succeeds or the
+    budget runs out.  Converts a transient outage into a late-but-real
+    benchmark number instead of a watchdog 0.0 (VERDICT r03 next #1a).
+
+    At least one probe always runs, even on a tiny budget — 'relay down'
+    must never be reported without having actually probed."""
+    k = 0
+    while True:
+        k += 1
+        _progress["stage"] = f"tpu-probe-{k}"
+        remaining = deadline - time.monotonic()
+        # floor of 10s so the first probe is real even when the budget is
+        # nearly spent; later probes only run with genuine budget
+        if k > 1 and remaining <= 5.0:
+            return False
+        if _probe_tpu_alive(timeout=max(10.0, min(probe_timeout, remaining))):
+            return True
+        remaining = deadline - time.monotonic()
+        if remaining <= pause + 5.0:
+            return False
+        print(
+            f"# bench: TPU relay probe {k} failed; retrying in {pause:.0f}s "
+            f"({remaining:.0f}s of watchdog budget left)",
+            file=sys.stderr,
+        )
+        time.sleep(pause)
+
+
 def bench_libsodium_single_core(items, seconds=1.0):
     from stellar_tpu.crypto import sodium
 
@@ -82,6 +178,26 @@ def bench_libsodium_single_core(items, seconds=1.0):
 
 
 def main():
+    """Wrapper guaranteeing the one-JSON-line contract for EVERY caller
+    (the driver runs this file; the contract tests import bench and call
+    main() — both must get a line even when the backend RAISES instead of
+    hanging, e.g. 'UNAVAILABLE: TPU backend setup/compile error')."""
+    try:
+        _main()
+    except SystemExit:
+        raise
+    except BaseException as e:
+        if _try_emit(
+            {
+                "error": f"{type(e).__name__}: {str(e)[:300]} "
+                f"(at stage {_progress.get('stage')!r})"
+            }
+        ):
+            sys.exit(2)
+        raise
+
+
+def _main():
     batch = int(os.environ.get("BENCH_BATCH", "32768"))  # device chunk size
     nchunks = int(os.environ.get("BENCH_CHUNKS", "4"))  # pipelined chunks
     iters = int(os.environ.get("BENCH_ITERS", "4"))
@@ -91,7 +207,11 @@ def main():
     # BENCH_SLOW_RETRY times so a transient window doesn't define the round.
     slow_retries = int(os.environ.get("BENCH_SLOW_RETRY", "2"))
     good_rate = float(os.environ.get("BENCH_GOOD_RATE", "110000"))
-    watchdog = _arm_watchdog(float(os.environ.get("BENCH_WATCHDOG", "1500")))
+    watchdog_s = float(os.environ.get("BENCH_WATCHDOG", "1500"))
+    watchdog = _arm_watchdog(watchdog_s)
+    # everything below must finish before the watchdog fires; stage-skipping
+    # decisions measure against this deadline (60s safety margin)
+    deadline = _t_start + watchdog_s - 60.0
 
     from stellar_tpu.crypto import SecretKey
 
@@ -103,10 +223,23 @@ def main():
         items.append((sk.public_raw, msg, sk.sign(msg)))
 
     cpu_rate = bench_libsodium_single_core(items, seconds=1.0)
-    # the ops import touches the JAX backend — on a dead relay THIS is
-    # where the process wedges, so the CPU baseline is measured first and
-    # the watchdog line can carry it
-    _progress.update(stage="tpu-init", libsodium=round(cpu_rate, 1))
+    _progress.update(libsodium=round(cpu_rate, 1))
+    # Probe the relay from killable children BEFORE any in-process jax
+    # backend touch; keep probing (45s pauses) while the watchdog budget
+    # lasts, so an outage ending mid-window still produces a real number.
+    if not _platform_forced_cpu() and not _wait_for_tpu(deadline):
+        watchdog.cancel()
+        if _try_emit(
+            {
+                "relay_down": "every killable-subprocess TPU probe "
+                "failed within the watchdog window"
+            }
+        ):
+            sys.exit(2)
+        return  # watchdog emitted concurrently; it will os._exit(2)
+    # the ops import touches the JAX backend in-process; the probe above
+    # makes a hang here unlikely, and the watchdog still backstops it
+    _progress.update(stage="tpu-init")
     from stellar_tpu.ops.ed25519 import BatchVerifier
 
     _progress.update(stage="warmup")
@@ -144,31 +277,43 @@ def main():
     rate = best
 
     result = {
-        "metric": "ed25519_verifies_per_sec",
-        "value": round(rate, 1),
-        "unit": "verifies/sec",
-        "vs_baseline": round(rate / 200_000.0, 3),
         "batch": batch,
         "chunks": nchunks,
         "iters": iters,
-        "libsodium_single_core_per_sec": round(cpu_rate, 1),
         "speedup_vs_libsodium_core": round(rate / cpu_rate, 2),
         "device": _device_kind(),
     }
     _progress.update(stage="ledger-close", rate=rate)
     if os.environ.get("BENCH_SKIP_CLOSE", "0") != "1":
-        try:
-            result.update(
-                bench_ledger_close(
-                    n_txs=int(os.environ.get("BENCH_CLOSE_TXS", "5000")),
-                    n_ledgers=int(os.environ.get("BENCH_CLOSE_LEDGERS", "3")),
-                )
+        n_close_txs = int(os.environ.get("BENCH_CLOSE_TXS", "5000"))
+        n_close_ledgers = int(os.environ.get("BENCH_CLOSE_LEDGERS", "3"))
+        remaining = deadline - time.monotonic()
+        # budget scales with the workload knobs: ~420s covers the default
+        # 5000-tx/3-ledger stage (setup ledgers + warmup + timed closes all
+        # scale with n_txs; timed closes also with n_ledgers)
+        need = max(
+            120.0,
+            420.0 * (n_close_txs / 5000.0) * max(1.0, n_close_ledgers / 3.0),
+        )
+        if remaining < need:
+            # relay probing ate the window; protect the verify headline
+            # rather than let the close stage run into the watchdog
+            result["ledger_close_skipped"] = (
+                f"only {remaining:.0f}s of watchdog budget left "
+                f"(<{need:.0f}s estimated for {n_close_txs} txs)"
             )
-        except Exception as e:  # the verify headline must still be reported
-            result["ledger_close_error"] = str(e)[:200]
-    _done.set()
+        else:
+            try:
+                result.update(
+                    bench_ledger_close(
+                        n_txs=n_close_txs, n_ledgers=n_close_ledgers
+                    )
+                )
+            except Exception as e:  # headline must still be reported
+                result["ledger_close_error"] = str(e)[:200]
     watchdog.cancel()
-    print(json.dumps(result))
+    if not _try_emit(result):
+        return  # watchdog fired mid-close and already emitted; it exits
 
 
 def bench_ledger_close(n_txs=5000, n_ledgers=3):

@@ -9,13 +9,15 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def run_bench(env_extra, timeout=240):
+def run_bench(env_extra, timeout=240, force_cpu=True):
     # ambient BENCH_* knobs (from manual hardware runs) must not leak in
     env = {k: v for k, v in os.environ.items() if not k.startswith("BENCH_")}
     env.update(env_extra)
     code = (
         "import jax; jax.config.update('jax_platforms','cpu');"
         "import bench; bench.main()"
+        if force_cpu
+        else "import bench; bench.main()"
     )
     return subprocess.run(
         [sys.executable, "-c", code],
@@ -44,6 +46,43 @@ def test_bench_emits_one_json_line():
     assert out["metric"] == "ed25519_verifies_per_sec"
     assert out["value"] > 0
     assert "watchdog" not in out
+
+
+def test_bench_relay_down_reports_one_line_and_exits_2():
+    """When every killable-subprocess TPU probe fails (simulated here with
+    an unsatisfiable JAX_PLATFORMS), bench must emit exactly one JSON line
+    carrying the libsodium baseline and exit 2 — not hang until the
+    watchdog (the r03 failure mode that recorded 0.0 after 1500s)."""
+    r = run_bench(
+        {
+            "BENCH_BATCH": "128",
+            "JAX_PLATFORMS": "cuda",  # no such plugin here: probe fails fast
+            # deadline ~= 5s: the guaranteed first probe runs (10s floor)
+            # and fails quickly; no budget left for a 45s retry pause
+            "BENCH_WATCHDOG": "65",
+        },
+        force_cpu=False,
+    )
+    assert r.returncode == 2, (r.stdout, r.stderr[-500:])
+    lines = [l for l in r.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, r.stdout
+    out = json.loads(lines[0])
+    assert "relay_down" in out
+    assert out["value"] == 0.0
+    assert out["libsodium_single_core_per_sec"] > 0
+
+
+def test_probe_tpu_alive_success_path(monkeypatch):
+    """The killable-subprocess probe must report True on a healthy backend
+    (here: the child inherits JAX_PLATFORMS=cpu and sees CPU devices)."""
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+
+        assert bench._probe_tpu_alive(timeout=90)
+    finally:
+        sys.path.pop(0)
 
 
 def test_bench_watchdog_fires_with_partial_result():
