@@ -124,10 +124,17 @@ def point_negate(p):
     return (fe.neg(X), Y, Z, fe.neg(T))
 
 
-def compress(p):
-    """-> ((32, N) bytes, x-parity already folded into byte 31)."""
+def compress(p, batch_inv: bool = False):
+    """-> ((32, N) bytes, x-parity already folded into byte 31).
+
+    ``batch_inv`` switches the Z inversion to fe.inv_batch (tree-product
+    Montgomery inversion across lanes) — correct only when the batch axis
+    is local to the caller (Pallas tile / unsharded XLA batch; NOT under a
+    mesh-sharded jit, where cross-lane slicing would force collectives) and
+    when zero-Z lanes are masked downstream (inv_batch returns garbage for
+    them, not 0)."""
     X, Y, Z, _ = p
-    zinv = fe.inv(Z)
+    zinv = fe.inv_batch(Z) if batch_inv else fe.inv(Z)
     x = fe.mul(X, zinv)
     y = fe.mul(Y, zinv)
     by = fe.bytes_from_limbs(fe.canonical(y))
@@ -227,13 +234,15 @@ def _build_a_table(neg_a):
 # ---------------------------------------------------------------------------
 
 
-def verify_kernel(a_bytes, r_bytes, s_nibs, h_nibs):
+def verify_kernel(a_bytes, r_bytes, s_nibs, h_nibs, batch_inv: bool = False):
     """All-device batched check R' == R.
 
     a_bytes   (32,N) — public key A bytes (little-endian, sign in bit 255)
     r_bytes   (32,N) — signature R bytes (to compare against)
     s_nibs    (64,N) — s scalar nibbles, little-endian
     h_nibs    (64,N) — h = SHA512(R‖A‖M) mod L nibbles, little-endian
+    batch_inv — use lane-tree Montgomery inversion in compress; only valid
+                when the batch axis is unsharded (see compress)
     returns   (N,) bool
     """
     a_sign = a_bytes[31] >> 7
@@ -257,7 +266,7 @@ def verify_kernel(a_bytes, r_bytes, s_nibs, h_nibs):
         return acc
 
     acc = jax.lax.fori_loop(0, WINDOWS, body, point_identity(n))
-    enc = compress(acc)
+    enc = compress(acc, batch_inv=batch_inv)
     match = jnp.all(enc == r_bytes, axis=0)
     return match & ~fail
 
@@ -331,7 +340,8 @@ class BatchVerifier:
             from .ed25519_pallas import verify_kernel_pallas
 
             return verify_kernel_pallas
-        return jax.jit(verify_kernel)
+        # unsharded batch axis: the lane-tree batched inversion is safe
+        return jax.jit(partial(verify_kernel, batch_inv=True))
 
     def _bucket(self, n: int) -> int:
         b = self.min_device_batch

@@ -111,7 +111,7 @@ def _kernel(
             return acc
 
         acc = jax.lax.fori_loop(0, ed.WINDOWS, body, ed.point_identity(n))
-        enc = ed.compress(acc)
+        enc = ed.compress(acc, batch_inv=True)
         match = jnp.all(enc == r_bytes, axis=0)
         out_ref[:] = (match & ~fail)[None]
 

@@ -319,6 +319,47 @@ def pow_p58(z):
     return mul(_sq_n(t3, 2), z)  # 2^252 - 4 + 1 = 2^252 - 3
 
 
+def inv_batch(z, min_width: int = 128):
+    """Montgomery-style batched inversion across the lane (batch) axis.
+
+    ``inv`` runs a ~254-step square/multiply ladder on every lane; on TPU a
+    (20, 512) tile occupies four 128-lane vregs, so the ladder's cost is
+    proportional to width.  Tree-reduce the batch by pairwise lane products
+    down to ``min_width`` (one vreg), run the ladder ONCE at that width,
+    then expand the inverses back up: from i = 1/(a·b), 1/a = i·b and
+    1/b = i·a.  Extra cost ≈ 2–3 full-width muls; saving ≈ 3/4 of the
+    ladder at 512 lanes.
+
+    A single zero lane would null every tree product, poisoning the whole
+    batch, so zeros are substituted with 1 first; their output slot is
+    garbage (NOT 0, unlike ``inv``) — callers must already be masking those
+    lanes (in the verify kernel a zero Z can only arise from a
+    decompress-failed lane, which ``fail`` masks; complete Edwards
+    additions keep Z ≠ 0 for curve points).
+
+    mul/sqr use no broadcast constants, so narrow widths are safe under
+    the Pallas const-override scheme (constants there are pre-broadcast to
+    the full tile width and never reach this code path).
+    """
+    n = z.shape[1]
+    if n <= min_width or n % 2:
+        return inv(z)
+    zero = is_zero(z)
+    cur = select(zero, one_fe(z.shape[1:], z.dtype), z)
+    levels = [cur]
+    while cur.shape[1] > min_width and cur.shape[1] % 2 == 0:
+        half = cur.shape[1] // 2
+        cur = mul(cur[:, :half], cur[:, half:])
+        levels.append(cur)
+    invs = inv(cur)
+    for lvl in reversed(levels[:-1]):
+        half = lvl.shape[1] // 2
+        inv_lo = mul(invs, lvl[:, half:])
+        inv_hi = mul(invs, lvl[:, :half])
+        invs = jnp.concatenate([inv_lo, inv_hi], axis=1)
+    return invs
+
+
 def canonical(x):
     """Weakly-reduced -> fully reduced (< p), canonical limbs."""
     x = carry_exact(x)

@@ -56,7 +56,9 @@ def test_bench_relay_down_reports_one_line_and_exits_2():
     r = run_bench(
         {
             "BENCH_BATCH": "128",
-            "JAX_PLATFORMS": "cuda",  # no such plugin here: probe fails fast
+            # guaranteed-invalid platform name: the probe must fail on ANY
+            # machine, including dev boxes that do have a cuda plugin
+            "JAX_PLATFORMS": "nonexistent_platform",
             # deadline ~= 5s: the guaranteed first probe runs (10s floor)
             # and fails quickly; no budget left for a 45s retry pause
             "BENCH_WATCHDOG": "65",

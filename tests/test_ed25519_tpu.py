@@ -73,6 +73,28 @@ class TestFieldArithmetic:
         for i, x in enumerate(nz):
             assert _from_fe(got, i) % self.P == pow(x, (self.P - 5) // 8, self.P)
 
+    def test_inv_batch_tree_matches_inv(self):
+        # width 512 forces two tree levels (512 -> 256 -> 128); a zero lane
+        # must not poison the others (its own slot is unspecified)
+        rng = random.Random(17)
+        vals = [rng.randrange(1, self.P) for _ in range(512)]
+        zero_lane = 137
+        vals[zero_lane] = 0
+        a = _to_fe(vals)
+        got = jax.jit(lambda x: fe.inv_batch(x, min_width=128))(a)
+        for i, x in enumerate(vals):
+            if i == zero_lane:
+                continue
+            assert _from_fe(got, i) % self.P == pow(x, self.P - 2, self.P)
+
+    def test_inv_batch_small_and_odd_widths_fall_back(self):
+        rng = random.Random(19)
+        for width in (5, 16):
+            vals = [rng.randrange(1, self.P) for _ in range(width)]
+            got = jax.jit(fe.inv_batch)(_to_fe(vals))
+            for i, x in enumerate(vals):
+                assert _from_fe(got, i) % self.P == pow(x, self.P - 2, self.P)
+
     def test_canonical_edges(self):
         edge = [0, 1, self.P - 1, self.P, self.P + 5, 2**255 - 1]
         got = jax.jit(fe.canonical)(_to_fe(edge))
