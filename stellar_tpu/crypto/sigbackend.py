@@ -80,16 +80,29 @@ class TpuSigBackend(SigBackend):
 
     name = "tpu"
 
-    def __init__(self, max_batch: int = 4096, mesh=None):
+    def __init__(self, max_batch: int = 4096, mesh=None, cpu_cutover: int = 256):
         from ..ops.ed25519 import BatchVerifier  # lazy: JAX import
 
         self._verifier = BatchVerifier(max_batch=max_batch, mesh=mesh)
+        # Below this many cache misses a device round-trip costs more than
+        # looping libsodium on host (one relay RTT ≈ 68 ms ≈ 1,100 CPU
+        # verifies) — lone SCP envelopes and small tx sets must never pay
+        # device latency just because the backend is "tpu".
+        self.cpu_cutover = cpu_cutover
+        self.n_cutover_items = 0
 
     def verify_batch(self, items: Sequence[VerifyTriple]) -> List[bool]:
+        if len(items) < self.cpu_cutover:
+            self.n_cutover_items += len(items)
+            return [
+                sodium.verify_detached(sig, msg, pk) for pk, msg, sig in items
+            ]
         return self._verifier.verify(items)
 
     def stats(self) -> dict:
-        return self._verifier.stats()
+        s = self._verifier.stats()
+        s["cpu_cutover_items"] = self.n_cutover_items
+        return s
 
 
 def make_backend(kind: str = "cpu", cache: VerifySigCache = None, **kw) -> SigBackend:

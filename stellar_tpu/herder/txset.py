@@ -101,16 +101,57 @@ class TxSetFrame:
         return out
 
     # -- shared validity core ----------------------------------------------
+    def _collect_signature_triples(self, app) -> list:
+        triples = []
+        for tx in self.transactions:
+            triples.extend(tx.candidate_signature_pairs(app.database))
+        return triples
+
     def _prewarm_signature_cache(self, app) -> None:
         """One SigBackend batch for the entire set (the TPU flush point)."""
         backend = getattr(app, "sig_backend", None)
         if backend is None:
             return
-        triples = []
-        for tx in self.transactions:
-            triples.extend(tx.candidate_signature_pairs(app.database))
+        triples = self._collect_signature_triples(app)
         if triples:
             backend.verify_batch(triples)
+
+    def prewarm_signature_cache_async(self, app):
+        """Start the signature-cache prewarm on a worker thread; returns a
+        join() the caller must invoke before any signature check can depend
+        on the warmed cache.
+
+        Triple collection (DB reads via candidate_signature_pairs) happens
+        on the CALLER's thread — sqlite connections are not shared across
+        threads here.  Only the pure-compute flush (hashing + device/
+        libsodium verify + locked cache scatter-back, VerifySigCache) runs
+        on the worker, which lets ledger close overlap it with fee
+        processing (LedgerManager.close_ledger)."""
+        backend = getattr(app, "sig_backend", None)
+        if backend is None:
+            return lambda: None
+        triples = self._collect_signature_triples(app)
+        if not triples:
+            return lambda: None
+        import threading
+
+        err: List[BaseException] = []
+
+        def work():
+            try:
+                backend.verify_batch(triples)
+            except BaseException as e:  # re-raised at join()
+                err.append(e)
+
+        t = threading.Thread(target=work, name="sig-prewarm", daemon=True)
+        t.start()
+
+        def join():
+            t.join()
+            if err:
+                raise err[0]
+
+        return join
 
     def _account_tx_map(self) -> Dict[bytes, List[TransactionFrame]]:
         m: Dict[bytes, List[TransactionFrame]] = {}

@@ -284,11 +284,14 @@ class LedgerManager:
             ledger_delta = LedgerDelta(self.current.header, self.database)
 
             txs = ledger_data.tx_set.sort_for_apply()
-            # pre-warm the verify cache for the whole set in one batch —
-            # at apply time every signature check is a cache hit
-            ledger_data.tx_set._prewarm_signature_cache(self.app)
-
+            # pre-warm the verify cache for the whole set in one batch,
+            # overlapped with fee processing (signature checks only start
+            # at apply, after the join) — at apply time every check hits
+            join_prewarm = ledger_data.tx_set.prewarm_signature_cache_async(
+                self.app
+            )
             self._process_fees_seq_nums(txs, ledger_delta)
+            join_prewarm()
 
             tx_result_set = TransactionResultSet([])
             self._apply_transactions(txs, ledger_delta, tx_result_set)

@@ -53,7 +53,9 @@ class Application:
         # the SIGNATURE_BACKEND knob: every batch verify in the node flows
         # through this object (and the shared verify cache)
         self.sig_backend = make_backend(
-            config.SIGNATURE_BACKEND, max_batch=config.SIG_BATCH_MAX
+            config.SIGNATURE_BACKEND,
+            max_batch=config.SIG_BATCH_MAX,
+            cpu_cutover=config.TPU_CPU_CUTOVER,
         )
         self.bucket_manager = BucketManager(self)
         self.ledger_manager = LedgerManager(self)

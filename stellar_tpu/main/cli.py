@@ -256,8 +256,32 @@ def _run_node(cfg, new_db: bool, metrics) -> int:
     return 0
 
 
+def _honor_jax_platforms_env() -> None:
+    """Make ``JAX_PLATFORMS=cpu stellar-tpu ...`` actually run jax on CPU.
+
+    Deployment images may register an accelerator platform from
+    sitecustomize at interpreter start, which LATCHES jax's platform choice
+    before the env var is consulted — a node configured with
+    SIGNATURE_BACKEND=tpu would then hang in backend init whenever the
+    accelerator transport is down, even though the operator explicitly
+    asked for CPU.  Re-assert the operator's intent via jax.config (a
+    no-op when jax is absent or the platform already matches)."""
+    import os
+
+    want = os.environ.get("JAX_PLATFORMS")
+    if not want:
+        return
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", want)
+    except Exception:
+        pass  # jax not installed / unknown platform: surfaces at first use
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+    _honor_jax_platforms_env()
     from .config import Config
 
     conf_path = "stellar-tpu.cfg"

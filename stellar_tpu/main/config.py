@@ -70,6 +70,10 @@ class Config:
         # TPU-native addition: which SigBackend serves batch verifies
         self.SIGNATURE_BACKEND = "cpu"
         self.SIG_BATCH_MAX = 4096
+        # below this many cache-miss verifies the tpu backend loops
+        # libsodium instead of paying a device round-trip (tests set 0 to
+        # force every batch onto the device path)
+        self.TPU_CPU_CUTOVER = 256
 
     # -- loading -----------------------------------------------------------
     @classmethod

@@ -74,6 +74,32 @@ def test_bench_relay_down_reports_one_line_and_exits_2():
     assert out["libsodium_single_core_per_sec"] > 0
 
 
+def test_bench_close_stage_hang_is_killed_not_fatal():
+    """A relay stall mid-close must cost only the close stage: the child is
+    killed at BENCH_CLOSE_TIMEOUT, the verify headline still reports, and
+    the exit code stays 0 (the r04-start failure mode was the watchdog
+    firing at stage 'ledger-close' with a healthy verify number already
+    measured)."""
+    r = run_bench(
+        {
+            "BENCH_BATCH": "128",
+            "BENCH_CHUNKS": "1",
+            "BENCH_ITERS": "1",
+            "BENCH_GOOD_RATE": "1",
+            "BENCH_CLOSE_SUBPROC": "1",
+            "BENCH_CLOSE_FAKE_HANG": "1",
+            "BENCH_CLOSE_TIMEOUT": "5",
+        }
+    )
+    assert r.returncode == 0, (r.stdout, r.stderr[-500:])
+    lines = [l for l in r.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, r.stdout
+    out = json.loads(lines[0])
+    assert out["value"] > 0
+    assert "killed after 5s" in out["ledger_close_error"]
+    assert "watchdog" not in out
+
+
 def test_probe_tpu_alive_success_path(monkeypatch):
     """The killable-subprocess probe must report True on a healthy backend
     (here: the child inherits JAX_PLATFORMS=cpu and sees CPU devices)."""

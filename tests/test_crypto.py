@@ -182,6 +182,44 @@ class TestSigBackendCpu:
         assert hits == 8 and misses == 0
 
 
+class TestTpuBackendCutover:
+    """Small cache-miss batches must loop libsodium (one relay RTT costs
+    more than ~1,100 host verifies); batches at/over the cutover take the
+    device path.  Either way results are bit-identical."""
+
+    def _items(self, n, tag):
+        items, expected = [], []
+        for i in range(n):
+            sk = SecretKey.pseudo_random_for_testing(500 + i)
+            msg = b"%s %d" % (tag, i)
+            sig = sk.sign(msg)
+            if i % 3 == 0:
+                sig = sig[:-1] + bytes([sig[-1] ^ 1])
+                expected.append(False)
+            else:
+                expected.append(True)
+            items.append((sk.public_raw, msg, sig))
+        return items, expected
+
+    def test_small_batch_stays_on_host(self):
+        backend = make_backend("tpu", cpu_cutover=64)
+        verify_cache().clear()
+        items, expected = self._items(8, b"cutover-small")
+        assert backend.verify_batch(items) == expected
+        s = backend.stats()
+        assert s["cpu_cutover_items"] == 8
+        assert s["device_calls"] == 0
+
+    def test_large_batch_takes_device_path(self):
+        backend = make_backend("tpu", cpu_cutover=4)
+        verify_cache().clear()
+        items, expected = self._items(8, b"cutover-large")
+        assert backend.verify_batch(items) == expected
+        s = backend.stats()
+        assert s["cpu_cutover_items"] == 0
+        assert s["device_calls"] == 1
+
+
 class TestEcdh:
     def test_shared_key_agreement(self):
         a_sec = ecdh.ecdh_random_secret()

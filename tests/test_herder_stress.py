@@ -34,9 +34,11 @@ def clock():
     c.shutdown()
 
 
-def make_app(clock, instance):
-    cfg = T.get_test_config(instance)
+def make_app(clock, instance, backend="cpu"):
+    cfg = T.get_test_config(instance, backend=backend)
     cfg.MANUAL_CLOSE = False
+    if backend == "tpu":
+        cfg.TPU_CPU_CUTOVER = 0  # every batch must hit the device path
     app = Application(clock, cfg, new_db=True)
     app.herder = Herder(app)
     app.herder.bootstrap()
@@ -75,8 +77,9 @@ def sign_envelope_as(herder, env, signer):
     env.signature = signer.sign(payload)
 
 
-def test_flood_of_bad_sig_envelopes_all_rejected(clock):
-    app = make_app(clock, 70)
+@pytest.mark.parametrize("backend", ["cpu", "tpu"])
+def test_flood_of_bad_sig_envelopes_all_rejected(clock, backend):
+    app = make_app(clock, 70, backend=backend)
     lm = app.ledger_manager
     h = app.herder
     rng = random.Random(99)
@@ -208,11 +211,12 @@ def test_scp_envelopes_coalesce_into_one_sig_batch(clock):
     app.graceful_stop()
 
 
-def test_sustained_envelope_stress_with_batch_verify(clock):
+@pytest.mark.parametrize("backend", ["cpu", "tpu"])
+def test_sustained_envelope_stress_with_batch_verify(clock, backend):
     """1000 foreign envelopes pre-verified through the SigBackend batch
     path (the overlay's recv_scp_batch pattern), then fed to the herder —
     bit-identical accept/reject decisions, node stays synced."""
-    app = make_app(clock, 73)
+    app = make_app(clock, 73, backend=backend)
     h = app.herder
     lm = app.ledger_manager
     rng = random.Random(11)

@@ -20,10 +20,22 @@ def clock():
 
 
 @pytest.fixture
-def app(clock):
-    a = Application(clock, T.get_test_config(), new_db=True)
+def app(clock, request):
+    # indirect-parameterizable over the SIGNATURE_BACKEND knob: most tests
+    # run cpu-only; the node-level batch-verify tests run both backends
+    # (the tpu backend's XLA kernel runs on the CPU mesh in tests)
+    backend = getattr(request, "param", "cpu")
+    cfg = T.get_test_config(backend=backend)
+    if backend == "tpu":
+        cfg.TPU_CPU_CUTOVER = 0  # small test batches must hit the device path
+    a = Application(clock, cfg, new_db=True)
     yield a
     a.database.close()
+
+
+both_backends = pytest.mark.parametrize(
+    "app", ["cpu", "tpu"], indirect=True
+)
 
 
 @pytest.fixture
@@ -427,6 +439,7 @@ class TestBaselineMeasurementConfigs:
     """The two BASELINE.json measurement configs not covered elsewhere:
     3-of-5 multisig envelopes and a mixed-op TxSet through a real close."""
 
+    @both_backends
     def test_3_of_5_multisig_txset_through_batch_verify(self, app, root):
         a = fund(app, root, T.get_account(1), amount=10**11)
         signers = [T.get_account(20 + i) for i in range(5)]
@@ -466,6 +479,7 @@ class TestBaselineMeasurementConfigs:
         assert len(txset.transactions) == 6
         assert txset.check_valid(app)
 
+    @both_backends
     def test_mixed_op_txset_closes(self, app, root):
         """PathPayment, ManageOffer, SetOptions, CreateAccount in one set
         (the BASELINE.json mixed-op config), applied via a real close."""
@@ -533,6 +547,56 @@ def test_op_shares_tx_signing_account(app, root):
     op = tx.operations[0]
     assert op.load_account(app.database)
     assert op.source_account is tx.signing_account
+
+
+def test_cpu_and_tpu_backends_close_identical_ledgers():
+    """End-to-end equivalence: the same txset closed by a cpu-backed and a
+    tpu-backed Application must produce bit-identical ledger headers (the
+    system-level contract behind the differential kernel suite — the
+    backend knob may change WHERE signatures verify, never any state)."""
+    from stellar_tpu.herder.ledgerclose import LedgerCloseData
+    from stellar_tpu.herder.txset import TxSetFrame
+    from stellar_tpu.xdr.ledger import StellarValue
+
+    hashes = []
+    for backend in ("cpu", "tpu"):
+        clock = VirtualClock(VIRTUAL_TIME)
+        try:
+            cfg = T.get_test_config(83, backend=backend)
+            cfg.TPU_CPU_CUTOVER = 0
+            app = Application(clock, cfg, new_db=True)
+            try:
+                root = T.root_key_for(app)
+                a = fund(app, root, T.get_account(1), amount=10**11)
+                b = fund(app, root, T.get_account(2), amount=10**11)
+                lm = app.ledger_manager
+                txs = [
+                    T.tx_from_ops(
+                        app, a, (2 << 32) + 1 + j, [T.payment_op(b, 10**6)]
+                    )
+                    for j in range(5)
+                ]
+                # one bad-signature tx: must be trimmed identically
+                bad = T.tx_from_ops(app, a, (2 << 32) + 9,
+                                    [T.payment_op(b, 10**6)])
+                bad.envelope.signatures[0].signature = bytes(64)
+                txs.append(bad)
+                txset = TxSetFrame(lm.last_closed.hash, txs)
+                txset.sort_for_hash()
+                assert txset.trim_invalid(app) == [bad]
+                sv = StellarValue(
+                    txset.get_contents_hash(),
+                    lm.last_closed.header.scpValue.closeTime + 5, [], 0,
+                )
+                lm.close_ledger(
+                    LedgerCloseData(lm.current.header.ledgerSeq, txset, sv)
+                )
+                hashes.append(lm.last_closed.hash)
+            finally:
+                app.database.close()
+        finally:
+            clock.shutdown()
+    assert hashes[0] == hashes[1]
 
 
 def test_start_rejects_insane_quorum_set(clock):
