@@ -60,16 +60,18 @@ class CachingSigBackend(SigBackend):
         return self.inner.stats()
 
 
-class CpuSigBackend(SigBackend):
-    """libsodium loop — the reference's exact behavior, one verify at a time
-    (crypto_sign_verify_detached, SecretKey.cpp:277-279)."""
+def _sodium_verify_loop(items: Sequence[VerifyTriple]) -> List[bool]:
+    """One libsodium verify per triple — the reference's exact behavior
+    (crypto_sign_verify_detached, SecretKey.cpp:277-279).  Shared by the
+    cpu backend and the tpu backend's small-batch cutover."""
+    return [sodium.verify_detached(sig, msg, pk) for pk, msg, sig in items]
 
+
+class CpuSigBackend(SigBackend):
     name = "cpu"
 
     def verify_batch(self, items: Sequence[VerifyTriple]) -> List[bool]:
-        return [
-            sodium.verify_detached(sig, msg, pk) for pk, msg, sig in items
-        ]
+        return _sodium_verify_loop(items)
 
 
 class TpuSigBackend(SigBackend):
@@ -94,9 +96,7 @@ class TpuSigBackend(SigBackend):
     def verify_batch(self, items: Sequence[VerifyTriple]) -> List[bool]:
         if len(items) < self.cpu_cutover:
             self.n_cutover_items += len(items)
-            return [
-                sodium.verify_detached(sig, msg, pk) for pk, msg, sig in items
-            ]
+            return _sodium_verify_loop(items)
         return self._verifier.verify(items)
 
     def stats(self) -> dict:

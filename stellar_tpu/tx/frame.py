@@ -329,9 +329,9 @@ class TransactionFrame:
         op_timer = app.metrics.new_timer(("transaction", "op", "apply"))
         from ..xdr.ledger import OperationMeta
 
+        this_tx_delta = LedgerDelta(outer=delta)
         try:
             with db.transaction():
-                this_tx_delta = LedgerDelta(outer=delta)
                 for op in self.operations:
                     with op_timer.time_scope():
                         op_delta = LedgerDelta(outer=this_tx_delta)
@@ -353,6 +353,13 @@ class TransactionFrame:
                     raise _TxRollback()
         except _TxRollback:
             pass
+        finally:
+            # The SQL savepoint rollback above undoes the rows, but entry
+            # writes also populated the shared decoded-entry cache — flush
+            # every touched key or later loads read rolled-back state (the
+            # reference gets this from ~LedgerDelta calling rollback(),
+            # LedgerDelta.cpp:39-44,204-220).  No-op when committed.
+            this_tx_delta.rollback()
 
         if stray_signatures:
             return False

@@ -48,7 +48,12 @@ def is_asset_valid(asset) -> bool:
 
 
 def is_string32_valid(s: str) -> bool:
-    return len(s.encode("utf-8")) <= 32 and "\x00" not in s
+    """util/types.cpp:60-71 isString32Valid: every byte must be ASCII and
+    not a control character (rejects NUL, \\r, DEL, and anything >= 0x80 —
+    the reference's `c < 0` on signed char).  Length is the XDR codec's
+    job, but check it here too for defense in depth."""
+    b = s.encode("utf-8")
+    return len(b) <= 32 and all(0x20 <= c < 0x7F for c in b)
 
 
 class OperationFrame:

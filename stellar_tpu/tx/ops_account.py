@@ -35,7 +35,9 @@ from ..xdr.txs import (
 )
 from .opframe import OperationFrame, is_asset_valid, is_string32_valid
 
-ALL_ACCOUNT_AUTH_FLAGS = 0x3  # AUTH_REQUIRED | AUTH_REVOCABLE
+# AUTH_REQUIRED | AUTH_REVOCABLE | AUTH_IMMUTABLE — once immutable is set,
+# NO auth flag (immutable included) may change (SetOptionsOpFrame.cpp:15-18)
+ALL_ACCOUNT_AUTH_FLAGS = 0x7
 MAX_SIGNERS = 20
 
 # inflation constants (InflationOpFrame.cpp:12-19)

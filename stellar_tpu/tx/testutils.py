@@ -183,6 +183,27 @@ def inflation_op(source=None) -> X.Operation:
 # -- apply helpers (TxTests applyCheck pattern) -----------------------------
 
 
+def close_ledger_on(app, close_time: int, txs=()) -> None:
+    """The reference's closeLedgerOn (TxTests.cpp): close one real ledger
+    at a chosen closeTime, optionally carrying transactions."""
+    from ..herder.ledgerclose import LedgerCloseData
+    from ..herder.txset import TxSetFrame
+    from ..xdr.ledger import StellarValue
+
+    lm = app.ledger_manager
+    txset = TxSetFrame(lm.last_closed.hash, list(txs))
+    txset.sort_for_hash()
+    sv = StellarValue(txset.get_contents_hash(), close_time, [], 0)
+    lm.close_ledger(LedgerCloseData(lm.current.header.ledgerSeq, txset, sv))
+
+
+def test_date(day: int, month: int, year: int) -> int:
+    """UTC epoch seconds (the reference's getTestDate)."""
+    import calendar
+
+    return calendar.timegm((year, month, day, 0, 0, 0))
+
+
 def apply_tx(app, tx: TransactionFrame, expect_code=None) -> TransactionFrame:
     """Charge fee+seq then apply against the current ledger delta, like one
     iteration of closeLedger's hot loop; commits to the DB."""

@@ -554,9 +554,7 @@ def test_cpu_and_tpu_backends_close_identical_ledgers():
     tpu-backed Application must produce bit-identical ledger headers (the
     system-level contract behind the differential kernel suite — the
     backend knob may change WHERE signatures verify, never any state)."""
-    from stellar_tpu.herder.ledgerclose import LedgerCloseData
     from stellar_tpu.herder.txset import TxSetFrame
-    from stellar_tpu.xdr.ledger import StellarValue
 
     hashes = []
     for backend in ("cpu", "tpu"):
@@ -584,12 +582,10 @@ def test_cpu_and_tpu_backends_close_identical_ledgers():
                 txset = TxSetFrame(lm.last_closed.hash, txs)
                 txset.sort_for_hash()
                 assert txset.trim_invalid(app) == [bad]
-                sv = StellarValue(
-                    txset.get_contents_hash(),
-                    lm.last_closed.header.scpValue.closeTime + 5, [], 0,
-                )
-                lm.close_ledger(
-                    LedgerCloseData(lm.current.header.ledgerSeq, txset, sv)
+                T.close_ledger_on(
+                    app,
+                    lm.last_closed.header.scpValue.closeTime + 5,
+                    txset.transactions,
                 )
                 hashes.append(lm.last_closed.hash)
             finally:

@@ -1,0 +1,359 @@
+"""Envelope authn/authz corpus (reference: src/transactions/TxEnvelopeTests.cpp).
+
+Ports the reference's multisig/threshold edge matrix: missing/corrupt/
+wrong-hint/surplus signatures, threshold arithmetic across signer weights,
+multi-op transactions with per-op source accounts (including an account
+created earlier in the SAME transaction), and the common-transaction
+validity gates (fee, sequence, time bounds).  Each test cites the
+TxEnvelopeTests.cpp section it pins.
+"""
+
+import pytest
+
+import stellar_tpu.xdr as X
+from stellar_tpu.ledger.accountframe import AccountFrame
+from stellar_tpu.main.application import Application
+from stellar_tpu.tx import testutils as T
+from stellar_tpu.util import VIRTUAL_TIME, VirtualClock
+
+RC = X.TransactionResultCode
+ORC = X.OperationResultCode
+
+
+@pytest.fixture
+def clock():
+    c = VirtualClock(VIRTUAL_TIME)
+    yield c
+    c.shutdown()
+
+
+@pytest.fixture
+def app(clock):
+    a = Application(clock, T.get_test_config(), new_db=True)
+    yield a
+    a.database.close()
+
+
+@pytest.fixture
+def root(app):
+    return T.root_key_for(app)
+
+
+def seq_of(app, key) -> int:
+    return AccountFrame.load_account(
+        key.get_public_key(), app.database
+    ).get_seq_num()
+
+
+def payment_amount(app) -> int:
+    return app.ledger_manager.current.header.baseReserve * 10
+
+
+def fund(app, root, dest, amount):
+    T.apply_tx(
+        app,
+        T.tx_from_ops(app, root, seq_of(app, root) + 1,
+                      [T.create_account_op(dest, amount)]),
+        expect_code=RC.txSUCCESS,
+    )
+
+
+class TestOuterEnvelope:
+    """TxEnvelopeTests.cpp:51-106."""
+
+    def _tx(self, app, root):
+        a1 = T.get_account(1)
+        return T.tx_from_ops(
+            app, root, seq_of(app, root) + 1,
+            [T.create_account_op(a1, payment_amount(app))],
+        )
+
+    def test_no_signature(self, app, root):
+        tx = self._tx(app, root)
+        tx.envelope.signatures = []
+        T.apply_tx(app, tx, expect_code=RC.txBAD_AUTH)
+
+    def test_bad_signature(self, app, root):
+        tx = self._tx(app, root)
+        sig = tx.envelope.signatures[0]
+        tx.envelope.signatures = [
+            X.DecoratedSignature(sig.hint, bytes([123]) * 32)
+        ]
+        T.apply_tx(app, tx, expect_code=RC.txBAD_AUTH)
+
+    def test_bad_signature_wrong_hint(self, app, root):
+        tx = self._tx(app, root)
+        sig = tx.envelope.signatures[0]
+        tx.envelope.signatures = [
+            X.DecoratedSignature(b"\x01" * 4, sig.signature)
+        ]
+        T.apply_tx(app, tx, expect_code=RC.txBAD_AUTH)
+
+    def test_signed_twice_is_extra(self, app, root):
+        tx = self._tx(app, root)
+        tx.add_signature(T.get_account(1))
+        T.apply_tx(app, tx, expect_code=RC.txBAD_AUTH_EXTRA)
+
+    def test_unused_signature_is_extra(self, app, root):
+        tx = self._tx(app, root)
+        tx.add_signature(T.get_account(66))  # bogus key
+        T.apply_tx(app, tx, expect_code=RC.txBAD_AUTH_EXTRA)
+
+
+class TestMultisigThresholds:
+    """TxEnvelopeTests.cpp:108-187: master 100, thresholds 10/50/100,
+    s1 weight 5 (below low), s2 weight 95 (med rights)."""
+
+    @pytest.fixture
+    def multisig(self, app, root):
+        a1 = T.get_account(1)
+        fund(app, root, a1, payment_amount(app))
+        s1 = T.get_account(11)
+        s2 = T.get_account(12)
+        seq = seq_of(app, a1)
+        T.apply_tx(
+            app,
+            T.tx_from_ops(app, a1, seq + 1, [T.set_options_op(
+                master_weight=100, low=10, med=50, high=100,
+                signer=X.Signer(s1.get_public_key(), 5),
+            )]),
+            expect_code=RC.txSUCCESS,
+        )
+        T.apply_tx(
+            app,
+            T.tx_from_ops(app, a1, seq + 2, [T.set_options_op(
+                signer=X.Signer(s2.get_public_key(), 95),
+            )]),
+            expect_code=RC.txSUCCESS,
+        )
+        return a1, s1, s2, seq + 2
+
+    def test_not_enough_rights_envelope(self, app, root, multisig):
+        a1, s1, s2, seq = multisig
+        tx = T.tx_from_ops(app, a1, seq + 1, [T.payment_op(root, 1000)])
+        tx.envelope.signatures = []
+        tx.add_signature(s1)  # weight 5 < med 50
+        T.apply_tx(app, tx, expect_code=RC.txBAD_AUTH)
+
+    def test_not_enough_rights_operation(self, app, root, multisig):
+        a1, s1, s2, seq = multisig
+        # updating thresholds requires high (100); s2 alone has 95
+        tx = T.tx_from_ops(app, a1, seq + 1, [T.set_options_op(
+            master_weight=100, low=10, med=50, high=100,
+            signer=X.Signer(s1.get_public_key(), 5),
+        )])
+        tx.envelope.signatures = []
+        tx.add_signature(s2)
+        T.apply_tx(app, tx, expect_code=RC.txFAILED)
+        assert T.op_result_of(tx).type == ORC.opBAD_AUTH
+
+    def test_two_signatures_reach_threshold(self, app, root, multisig):
+        a1, s1, s2, seq = multisig
+        tx = T.tx_from_ops(app, a1, seq + 1, [T.payment_op(root, 1000)])
+        tx.envelope.signatures = []
+        tx.add_signature(s1)
+        tx.add_signature(s2)  # 5 + 95 = 100 >= med 50
+        T.apply_tx(app, tx, expect_code=RC.txSUCCESS)
+        assert T.inner_op_code(tx) == X.PaymentResultCode.PAYMENT_SUCCESS
+
+
+class TestBatching:
+    """TxEnvelopeTests.cpp:189-421 — multi-op envelopes with per-op
+    source accounts."""
+
+    def test_empty_batch(self, app, root):
+        tx = T.tx_from_ops(app, root, seq_of(app, root) + 1, [],
+                           fee=1000)
+        assert not tx.check_valid(app, 0)
+        T.apply_tx(app, tx, expect_code=RC.txMISSING_OPERATION)
+
+    @pytest.fixture
+    def ab(self, app, root):
+        a1, b1 = T.get_account(1), T.get_account(2)
+        fund(app, root, a1, payment_amount(app))
+        fund(app, root, b1, payment_amount(app))
+        return a1, b1
+
+    def test_wrapped_op_missing_signature(self, app, root, ab):
+        a1, b1 = ab
+        tx = T.tx_from_ops(
+            app, a1, seq_of(app, a1) + 1,
+            [T.payment_op(root, 1000, source=b1)],
+        )
+        tx.envelope.signatures = []
+        tx.add_signature(a1)
+        assert not tx.check_valid(app, 0)
+        T.apply_tx(app, tx, expect_code=RC.txFAILED)
+        assert T.op_result_of(tx).type == ORC.opBAD_AUTH
+
+    def test_wrapped_op_with_signature_succeeds(self, app, root, ab):
+        a1, b1 = ab
+        tx = T.tx_from_ops(
+            app, a1, seq_of(app, a1) + 1,
+            [T.payment_op(root, 1000, source=b1)],
+        )
+        tx.envelope.signatures = []
+        tx.add_signature(a1)
+        tx.add_signature(b1)
+        assert tx.check_valid(app, 0)
+        T.apply_tx(app, tx, expect_code=RC.txSUCCESS)
+        assert T.inner_op_code(tx) == X.PaymentResultCode.PAYMENT_SUCCESS
+
+    def test_one_invalid_op_still_charges_double_fee(self, app, root, ab):
+        """Second op malformed (selling == buying): whole tx txFAILED,
+        both ops' fees charged, first op reports success result
+        (TxEnvelopeTests.cpp:258-299)."""
+        a1, b1 = ab
+        idr = X.Asset.alphanum4(b"IDR", b1.get_public_key())
+        tx = T.tx_from_ops(
+            app, a1, seq_of(app, a1) + 1,
+            [
+                T.payment_op(root, 1000),
+                T.manage_offer_op(idr, idr, 1000, X.Price(1, 1), source=b1),
+            ],
+        )
+        tx.add_signature(b1)
+        assert not tx.check_valid(app, 0)
+        balance_before = AccountFrame.load_account(
+            a1.get_public_key(), app.database).get_balance()
+        T.apply_tx(app, tx, expect_code=RC.txFAILED)
+        assert tx.result.feeCharged == 2 * app.ledger_manager.get_tx_fee()
+        assert T.inner_op_code(tx, 0) == X.PaymentResultCode.PAYMENT_SUCCESS
+        assert (T.inner_op_code(tx, 1)
+                == X.ManageOfferResultCode.MANAGE_OFFER_MALFORMED)
+        # fee left the source; no payment effect survived the rollback
+        balance_after = AccountFrame.load_account(
+            a1.get_public_key(), app.database).get_balance()
+        assert balance_after == balance_before - tx.result.feeCharged
+
+    def test_one_failed_op_rolls_back_the_other(self, app, root, ab):
+        """Second payment underfunded: txFAILED, double fee, first op's
+        result shows success but state rolled back
+        (TxEnvelopeTests.cpp:300-340)."""
+        a1, b1 = ab
+        tx = T.tx_from_ops(
+            app, a1, seq_of(app, a1) + 1,
+            [
+                T.payment_op(root, 1000),
+                T.payment_op(root, payment_amount(app), source=b1),
+            ],
+        )
+        tx.add_signature(b1)
+        assert tx.check_valid(app, 0)
+        T.apply_tx(app, tx, expect_code=RC.txFAILED)
+        assert tx.result.feeCharged == 2 * app.ledger_manager.get_tx_fee()
+        assert T.inner_op_code(tx, 0) == X.PaymentResultCode.PAYMENT_SUCCESS
+        assert (T.inner_op_code(tx, 1)
+                == X.PaymentResultCode.PAYMENT_UNDERFUNDED)
+
+    def test_both_ops_succeed(self, app, root, ab):
+        a1, b1 = ab
+        tx = T.tx_from_ops(
+            app, a1, seq_of(app, a1) + 1,
+            [
+                T.payment_op(root, 1000),
+                T.payment_op(root, 1000, source=b1),
+            ],
+        )
+        tx.add_signature(b1)
+        assert tx.check_valid(app, 0)
+        T.apply_tx(app, tx, expect_code=RC.txSUCCESS)
+        assert tx.result.feeCharged == 2 * app.ledger_manager.get_tx_fee()
+        assert T.inner_op_code(tx, 0) == X.PaymentResultCode.PAYMENT_SUCCESS
+        assert T.inner_op_code(tx, 1) == X.PaymentResultCode.PAYMENT_SUCCESS
+
+    def test_op_source_created_in_same_tx(self, app, root, ab):
+        """Op 1 creates C, op 2 spends from C — C's signature verifies
+        against the account created mid-transaction
+        (TxEnvelopeTests.cpp:379-421)."""
+        a1, b1 = ab
+        c1 = T.get_account(3)
+        tx = T.tx_from_ops(
+            app, b1, seq_of(app, b1) + 1,
+            [
+                T.create_account_op(c1, payment_amount(app) // 2),
+                T.payment_op(root, 1000, source=c1),
+            ],
+        )
+        tx.add_signature(c1)
+        assert tx.check_valid(app, 0)
+        T.apply_tx(app, tx, expect_code=RC.txSUCCESS)
+        assert tx.result.feeCharged == 2 * app.ledger_manager.get_tx_fee()
+        assert (T.inner_op_code(tx, 0)
+                == X.CreateAccountResultCode.CREATE_ACCOUNT_SUCCESS)
+        assert T.inner_op_code(tx, 1) == X.PaymentResultCode.PAYMENT_SUCCESS
+
+
+class TestCommonTransaction:
+    """TxEnvelopeTests.cpp:423-516 — fee/seq/time validity gates."""
+
+    @pytest.fixture
+    def funded(self, app, root):
+        a1 = T.get_account(1)
+        fund(app, root, a1, payment_amount(app))
+        return a1
+
+    def test_insufficient_fee(self, app, root, funded):
+        tx = T.tx_from_ops(
+            app, root, seq_of(app, root) + 1,
+            [T.payment_op(funded, 1000)],
+            fee=app.ledger_manager.get_tx_fee() - 1,
+        )
+        T.apply_tx(app, tx, expect_code=RC.txINSUFFICIENT_FEE)
+
+    @staticmethod
+    def _apply_check(app, tx, expect):
+        """The reference's applyCheck shape (TxTests.cpp:38-54): checkValid
+        sets the code; fees are only processed when the account/seq are
+        sane, and a BAD_SEQ tx is never applied."""
+        from stellar_tpu.ledger.delta import LedgerDelta
+
+        lm = app.ledger_manager
+        tx.check_valid(app, 0)
+        code = tx.get_result_code()
+        with app.database.transaction():
+            delta = LedgerDelta(lm.current.header, app.database)
+            if code not in (RC.txNO_ACCOUNT, RC.txBAD_SEQ):
+                tx.process_fee_seq_num(delta, lm)
+            if code != RC.txBAD_SEQ:
+                tx.apply(delta, app)
+            delta.commit()
+        assert tx.get_result_code() == expect, tx.get_result_code()
+
+    def test_duplicate_tx_bad_seq(self, app, root, funded):
+        tx = T.tx_from_ops(
+            app, root, seq_of(app, root) + 1, [T.payment_op(funded, 1000)]
+        )
+        T.apply_tx(app, tx, expect_code=RC.txSUCCESS)
+        dup = T.tx_from_ops(
+            app, root, tx.get_seq_num(), [T.payment_op(funded, 1000)]
+        )
+        self._apply_check(app, dup, RC.txBAD_SEQ)
+
+    def test_seq_gap_bad_seq(self, app, root, funded):
+        tx = T.tx_from_ops(
+            app, root, seq_of(app, root) + 2, [T.payment_op(funded, 1000)]
+        )
+        self._apply_check(app, tx, RC.txBAD_SEQ)
+
+    def _tx_with_bounds(self, app, root, funded, lo, hi):
+        tx = T.tx_from_ops(
+            app, root, seq_of(app, root) + 1, [T.payment_op(funded, 1000)]
+        )
+        tx.envelope.tx.timeBounds = X.TimeBounds(lo, hi)
+        tx.envelope.signatures = []
+        tx.add_signature(root)
+        return tx
+
+    def test_time_bounds_gates(self, app, root, funded):
+        """too young -> txTOO_EARLY; in range -> success; expired ->
+        txTOO_LATE (TxEnvelopeTests.cpp:466-501, 1-3 July 2014)."""
+        start = T.test_date(1, 7, 2014)
+        T.close_ledger_on(app, start)
+        tx = self._tx_with_bounds(app, root, funded, start + 1000,
+                                  start + 10000)
+        T.apply_tx(app, tx, expect_code=RC.txTOO_EARLY)
+        tx = self._tx_with_bounds(app, root, funded, 1000, start + 300000)
+        T.apply_tx(app, tx, expect_code=RC.txSUCCESS)
+        tx = self._tx_with_bounds(app, root, funded, 1000, start - 10)
+        T.apply_tx(app, tx, expect_code=RC.txTOO_LATE)

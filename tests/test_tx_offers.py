@@ -1,0 +1,648 @@
+"""Order-book scenario corpus (reference: src/transactions/OfferTests.cpp).
+
+Ports the reference's crossing matrix — passive offers, negative creation
+codes, offer manipulation, partial fills with the seller-biased price
+rounding, cross-self rejection, value-extraction resistance, trust-line
+limits mid-cross, unauthorized sellers, and issuer offers.  Each test cites
+the OfferTests.cpp section it pins.  Amount checks follow the reference's
+checkAmounts(a, b, maxd): a in [b - maxd, b] — crossing may round in the
+resting seller's favor by up to maxd stroops.
+"""
+
+import pytest
+
+import stellar_tpu.xdr as X
+from stellar_tpu.main.application import Application
+from stellar_tpu.ledger.offerframe import OfferFrame
+from stellar_tpu.ledger.trustframe import TrustFrame
+from stellar_tpu.tx import testutils as T
+from stellar_tpu.util import VIRTUAL_TIME, VirtualClock
+
+RC = X.TransactionResultCode
+OC = X.ManageOfferResultCode
+EF = X.ManageOfferEffect
+
+M = 1_000_000  # assetMultiplier (OfferTests.cpp:47)
+TL_BALANCE = 100_000 * M  # trustLineBalance
+TL_LIMIT = TL_BALANCE * 10  # trustLineLimit
+INT64_MAX = 2**63 - 1
+
+
+@pytest.fixture
+def clock():
+    c = VirtualClock(VIRTUAL_TIME)
+    yield c
+    c.shutdown()
+
+
+@pytest.fixture
+def app(clock):
+    a = Application(clock, T.get_test_config(), new_db=True)
+    yield a
+    a.database.close()
+
+
+@pytest.fixture
+def root(app):
+    return T.root_key_for(app)
+
+
+class Acct:
+    """Account handle carrying its own next-seq counter (the reference's
+    `SequenceNumber x_seq = getAccountSeqNum(x, app) + 1` idiom)."""
+
+    def __init__(self, app, key):
+        from stellar_tpu.ledger.accountframe import AccountFrame
+
+        self.app = app
+        self.key = key
+        af = AccountFrame.load_account(key.get_public_key(), app.database)
+        self._seq = af.get_seq_num()
+
+    def next_seq(self):
+        self._seq += 1
+        return self._seq
+
+    def apply(self, ops, expect=RC.txSUCCESS):
+        tx = T.tx_from_ops(self.app, self.key, self.next_seq(), ops)
+        T.apply_tx(self.app, tx, expect_code=expect)
+        return tx
+
+
+def mk_account(app, root_acct, key, balance) -> Acct:
+    root_acct.apply([T.create_account_op(key, balance)])
+    return Acct(app, key)
+
+
+def offer_result(tx):
+    res = T.op_result_of(tx).value.value
+    assert res.type == OC.MANAGE_OFFER_SUCCESS, res.type
+    return res.value
+
+
+def offer_code(tx):
+    return T.op_result_of(tx).value.value.type
+
+
+def apply_offer(acct, selling, buying, price, amount, offer_id=0,
+                passive=False):
+    """-> (effect, offer_entry_or_None, claimed) on success."""
+    if passive:
+        op = T.create_passive_offer_op(selling, buying, amount, price)
+    else:
+        op = T.manage_offer_op(selling, buying, amount, price,
+                               offer_id=offer_id)
+    tx = acct.apply([op])
+    succ = offer_result(tx)
+    entry = succ.offer.value if succ.offer.type != EF.MANAGE_OFFER_DELETED \
+        else None
+    return succ.offer.type, entry, succ.offersClaimed
+
+
+def apply_offer_bad(acct, selling, buying, price, amount, expect_op_code,
+                    offer_id=0):
+    op = T.manage_offer_op(selling, buying, amount, price, offer_id=offer_id)
+    tx = acct.apply([op], expect=RC.txFAILED)
+    assert offer_code(tx) == expect_op_code
+
+
+def load_offer(app, acct, offer_id):
+    return OfferFrame.load_offer(
+        acct.key.get_public_key(), offer_id, app.database
+    )
+
+
+def line_balance(app, acct, asset) -> int:
+    line = TrustFrame.load_trust_line(
+        acct.key.get_public_key(), asset, app.database
+    )
+    assert line is not None
+    return line.get_balance()
+
+
+def check_amounts(a, b, maxd=1):
+    """TxTests.cpp:863 checkAmounts: a in [b - maxd, b]."""
+    assert b - maxd <= a <= b, f"{a} not in [{b - maxd}, {b}]"
+
+
+def last_generated_id(app) -> int:
+    return app.ledger_manager.current.header.idPool
+
+
+@pytest.fixture
+def world(app, root):
+    """Gateway + IDR/USD assets (OfferTests.cpp:58-79)."""
+    r = Acct(app, root)
+    min2 = app.ledger_manager.get_min_balance(2) + 20 * app.ledger_manager.get_tx_fee()
+    gw_key = T.get_account(100)
+    gw = mk_account(app, r, gw_key, min2 * 10)
+    idr = X.Asset.alphanum4(b"IDR", gw_key.get_public_key())
+    usd = X.Asset.alphanum4(b"USD", gw_key.get_public_key())
+    return r, gw, idr, usd, min2
+
+
+def trust_and_fund(app, gw, acct, asset, code, amount, limit=TL_LIMIT):
+    acct.apply([T.change_trust_op(asset, limit)])
+    if amount:
+        gw.apply([T.payment_op(acct.key, amount, asset=asset)])
+
+
+class TestPassiveOffers:
+    """OfferTests.cpp:83-168."""
+
+    def _setup(self, app, root, world):
+        r, gw, idr, usd, min2 = world
+        a1 = mk_account(app, r, T.get_account(1), min2 * 2)
+        b1 = mk_account(app, r, T.get_account(2), min2 * 2)
+        for who in (a1, b1):
+            trust_and_fund(app, gw, who, idr, b"IDR", 0)
+            trust_and_fund(app, gw, who, usd, b"USD", 0)
+        gw.apply([T.payment_op(a1.key, TL_BALANCE, asset=idr)])
+        gw.apply([T.payment_op(b1.key, TL_BALANCE, asset=usd)])
+        first_id = last_generated_id(app) + 1
+        eff, entry, _ = apply_offer(a1, idr, usd, X.Price(1, 1), 100 * M)
+        assert eff == EF.MANAGE_OFFER_CREATED and entry.offerID == first_id
+        second_id = last_generated_id(app) + 1
+        eff, entry, _ = apply_offer(
+            b1, usd, idr, X.Price(1, 1), 100 * M, passive=True
+        )
+        assert eff == EF.MANAGE_OFFER_CREATED
+        assert second_id == first_id + 1
+        return a1, b1, idr, usd, first_id, second_id
+
+    def test_passive_offer_does_not_cross_equal_price(self, app, root, world):
+        a1, b1, idr, usd, first, second = self._setup(app, root, world)
+        o1 = load_offer(app, a1, first)
+        assert o1.offer.amount == 100 * M
+        assert not (o1.offer.flags & X.OfferEntryFlags.PASSIVE_FLAG)
+        o2 = load_offer(app, b1, second)
+        assert o2.offer.amount == 100 * M
+        assert o2.offer.flags & X.OfferEntryFlags.PASSIVE_FLAG
+
+    def test_passive_offer_better_price_crosses(self, app, root, world):
+        a1, b1, idr, usd, first, second = self._setup(app, root, world)
+        third = last_generated_id(app) + 1
+        eff, _, claimed = apply_offer(
+            b1, usd, idr, X.Price(99, 100), 100 * M, passive=True
+        )
+        # offer1 taken, offer3 never created (OfferTests.cpp:126-138)
+        assert eff == EF.MANAGE_OFFER_DELETED
+        assert load_offer(app, a1, first) is None
+        assert load_offer(app, b1, third) is None
+
+    def test_modify_passive_high_keeps_both(self, app, root, world):
+        a1, b1, idr, usd, first, second = self._setup(app, root, world)
+        eff, entry, _ = apply_offer(
+            b1, usd, idr, X.Price(100, 99), 100 * M, offer_id=second
+        )
+        assert eff == EF.MANAGE_OFFER_UPDATED
+        assert load_offer(app, a1, first).offer.amount == 100 * M
+        o2 = load_offer(app, b1, second)
+        assert o2.offer.price == X.Price(100, 99)
+        assert o2.offer.flags & X.OfferEntryFlags.PASSIVE_FLAG  # flag sticks
+
+    def test_modify_passive_low_crosses(self, app, root, world):
+        a1, b1, idr, usd, first, second = self._setup(app, root, world)
+        eff, _, _ = apply_offer(
+            b1, usd, idr, X.Price(99, 100), 100 * M, offer_id=second
+        )
+        assert eff == EF.MANAGE_OFFER_DELETED
+        assert load_offer(app, a1, first) is None
+        assert load_offer(app, b1, second) is None
+
+
+class TestNegativeCreation:
+    """OfferTests.cpp:170-236 — every rejection code, in the reference's
+    escalation order, plus no-offer-leakage at the end."""
+
+    def test_rejection_ladder(self, app, root, world):
+        r, gw, idr, usd, min2 = world
+        a1 = mk_account(app, r, T.get_account(1), min2)
+        one = X.Price(1, 1)
+        gw2_key = T.get_account(101)
+        idr2 = X.Asset.alphanum4(b"IDR", gw2_key.get_public_key())
+        usd2 = X.Asset.alphanum4(b"USD", gw2_key.get_public_key())
+
+        # missing IDR trust
+        apply_offer_bad(a1, idr, usd, one, 100, OC.MANAGE_OFFER_SELL_NO_TRUST)
+        # no issuer for selling
+        apply_offer_bad(a1, idr2, usd, one, 100,
+                        OC.MANAGE_OFFER_SELL_NO_ISSUER)
+        a1.apply([T.change_trust_op(idr, TL_LIMIT)])
+        # can't sell IDR without any
+        apply_offer_bad(a1, idr, usd, one, 100, OC.MANAGE_OFFER_UNDERFUNDED)
+        gw.apply([T.payment_op(a1.key, TL_LIMIT, asset=idr)])
+        # missing USD trust
+        apply_offer_bad(a1, idr, usd, one, 100, OC.MANAGE_OFFER_BUY_NO_TRUST)
+        # no issuer for buying
+        apply_offer_bad(a1, idr, usd2, one, 100, OC.MANAGE_OFFER_BUY_NO_ISSUER)
+        a1.apply([T.change_trust_op(usd, TL_LIMIT)])
+        # insufficient XLM for the offer's reserve bump
+        apply_offer_bad(a1, idr, usd, one, 100, OC.MANAGE_OFFER_LOW_RESERVE)
+        r.apply([T.payment_op(a1.key, min2)])
+        # buying line full
+        gw.apply([T.payment_op(a1.key, TL_LIMIT, asset=usd)])
+        apply_offer_bad(a1, idr, usd, one, 100, OC.MANAGE_OFFER_LINE_FULL)
+        # overflow probe: limit/balance at INT64_MAX stays LINE_FULL
+        a1.apply([T.change_trust_op(usd, INT64_MAX)])
+        gw.apply([T.payment_op(a1.key, INT64_MAX - TL_LIMIT, asset=usd)])
+        apply_offer_bad(a1, idr, usd, one, 100, OC.MANAGE_OFFER_LINE_FULL)
+        # no offer leaked into the book (OfferTests.cpp:231-235)
+        n = app.database.query_one("SELECT COUNT(*) FROM offers")[0]
+        assert n == 0
+
+
+class TestOfferManipulation:
+    """OfferTests.cpp:238-350 — cancel under degraded trust lines, update
+    price/amount/assets each preserving every other field."""
+
+    @pytest.fixture
+    def manip(self, app, root, world):
+        r, gw, idr, usd, _ = world
+        min_a = app.ledger_manager.get_min_balance(3)
+        a1 = mk_account(app, r, T.get_account(1), min_a + 10000)
+        trust_and_fund(app, gw, a1, usd, b"USD", 0)
+        trust_and_fund(app, gw, a1, idr, b"IDR", TL_BALANCE)
+        eff, entry, _ = apply_offer(a1, idr, usd, X.Price(1, 1), 100)
+        assert eff == EF.MANAGE_OFFER_CREATED
+        return r, gw, a1, idr, usd, entry
+
+    def _cancel_check(self, app, a1, idr, usd, offer_id):
+        eff, _, _ = apply_offer(a1, idr, usd, X.Price(1, 1), 0,
+                                offer_id=offer_id)
+        assert eff == EF.MANAGE_OFFER_DELETED
+        assert load_offer(app, a1, offer_id) is None
+
+    def test_cancel_typical(self, app, manip):
+        r, gw, a1, idr, usd, offer = manip
+        self._cancel_check(app, a1, idr, usd, offer.offerID)
+
+    def test_cancel_with_empty_selling_line(self, app, manip):
+        r, gw, a1, idr, usd, offer = manip
+        a1.apply([T.payment_op(gw.key, TL_BALANCE, asset=idr)])
+        self._cancel_check(app, a1, idr, usd, offer.offerID)
+
+    def test_cancel_with_deleted_selling_line(self, app, manip):
+        r, gw, a1, idr, usd, offer = manip
+        a1.apply([T.payment_op(gw.key, TL_BALANCE, asset=idr)])
+        a1.apply([T.change_trust_op(idr, 0)])
+        self._cancel_check(app, a1, idr, usd, offer.offerID)
+
+    def test_cancel_with_full_buying_line(self, app, manip):
+        r, gw, a1, idr, usd, offer = manip
+        gw.apply([T.payment_op(a1.key, TL_LIMIT, asset=usd)])
+        self._cancel_check(app, a1, idr, usd, offer.offerID)
+
+    def test_cancel_with_deleted_buying_line(self, app, manip):
+        r, gw, a1, idr, usd, offer = manip
+        a1.apply([T.change_trust_op(usd, 0)])
+        self._cancel_check(app, a1, idr, usd, offer.offerID)
+
+    def test_update_price_only_changes_price(self, app, manip):
+        r, gw, a1, idr, usd, org = manip
+        eff, _, _ = apply_offer(a1, idr, usd, X.Price(1, 2), 100,
+                                offer_id=org.offerID)
+        assert eff == EF.MANAGE_OFFER_UPDATED
+        mod = load_offer(app, a1, org.offerID).offer
+        assert mod.price == X.Price(1, 2)
+        assert (mod.offerID, mod.amount, mod.selling, mod.buying) == (
+            org.offerID, org.amount, org.selling, org.buying)
+
+    def test_update_amount_only_changes_amount(self, app, manip):
+        r, gw, a1, idr, usd, org = manip
+        eff, _, _ = apply_offer(a1, idr, usd, X.Price(1, 1), 10,
+                                offer_id=org.offerID)
+        assert eff == EF.MANAGE_OFFER_UPDATED
+        mod = load_offer(app, a1, org.offerID).offer
+        assert mod.amount == 10
+        assert (mod.offerID, mod.price, mod.selling, mod.buying) == (
+            org.offerID, org.price, org.selling, org.buying)
+
+    def test_update_swaps_selling_buying(self, app, manip):
+        r, gw, a1, idr, usd, org = manip
+        gw.apply([T.payment_op(a1.key, TL_BALANCE, asset=usd)])
+        eff, _, _ = apply_offer(a1, usd, idr, X.Price(1, 1), 100,
+                                offer_id=org.offerID)
+        assert eff == EF.MANAGE_OFFER_UPDATED
+        mod = load_offer(app, a1, org.offerID).offer
+        assert mod.selling == usd and mod.buying == idr
+        assert (mod.offerID, mod.amount, mod.price) == (
+            org.offerID, org.amount, org.price)
+
+
+@pytest.fixture
+def book(app, root, world):
+    """a1 with 22 resting sell-IDR-for-USD offers at 3/2
+    (OfferTests.cpp:352-420 'a1 setup properly' + 'multiple offers')."""
+    r, gw, idr, usd, min2 = world
+    nb = 22
+    min_a = app.ledger_manager.get_min_balance(3 + nb)
+    a1 = mk_account(app, r, T.get_account(1), min_a + 10000)
+    trust_and_fund(app, gw, a1, usd, b"USD", 0)
+    trust_and_fund(app, gw, a1, idr, b"IDR", TL_BALANCE)
+    price = X.Price(3, 2)  # sell 100 IDR for 150 USD
+    ids = []
+    for _ in range(nb):
+        eff, entry, _ = apply_offer(a1, idr, usd, price, 100 * M)
+        assert eff == EF.MANAGE_OFFER_CREATED
+        assert entry.price == price and entry.amount == 100 * M
+        ids.append(entry.offerID)
+    return r, gw, a1, idr, usd, ids, price
+
+
+def make_b1(app, r, gw, idr, usd, usd_amount):
+    min3 = app.ledger_manager.get_min_balance(3)
+    b1 = mk_account(app, r, T.get_account(2), min3 + 10000)
+    trust_and_fund(app, gw, b1, idr, b"IDR", 0)
+    trust_and_fund(app, gw, b1, usd, b"USD", usd_amount)
+    return b1
+
+
+class TestCrossingMatrix:
+    """OfferTests.cpp:430-780."""
+
+    def test_offer_that_does_not_cross(self, app, book):
+        r, gw, a1, idr, usd, ids, price = book
+        b1 = make_b1(app, r, gw, idr, usd, 20000 * M)
+        eff, entry, claimed = apply_offer(
+            b1, usd, idr, X.Price(2, 1), 40 * M
+        )
+        assert eff == EF.MANAGE_OFFER_CREATED and not claimed
+        o = load_offer(app, b1, entry.offerID).offer
+        assert o.price == X.Price(2, 1) and o.amount == 40 * M
+        for oid in ids:  # a1's book untouched
+            o = load_offer(app, a1, oid).offer
+            assert o.amount == 100 * M and o.price == price
+
+    def test_offer_crossing_own_offer_rejected(self, app, book):
+        r, gw, a1, idr, usd, ids, price = book
+        gw.apply([T.payment_op(a1.key, 20000 * M, asset=usd)])
+        a1.apply([T.payment_op(gw.key, TL_BALANCE, asset=idr)])
+        before = last_generated_id(app)
+        apply_offer_bad(a1, usd, idr, X.Price(2, 3), 150 * M,
+                        OC.MANAGE_OFFER_CROSS_SELF)
+        assert last_generated_id(app) == before
+        for oid in ids:
+            assert load_offer(app, a1, oid).offer.amount == 100 * M
+
+    def test_offer_that_crosses_exactly(self, app, book):
+        r, gw, a1, idr, usd, ids, price = book
+        b1 = make_b1(app, r, gw, idr, usd, 20000 * M)
+        would_be = last_generated_id(app) + 1
+        eff, _, _ = apply_offer(b1, usd, idr, X.Price(2, 3), 150 * M)
+        assert eff == EF.MANAGE_OFFER_DELETED
+        assert load_offer(app, b1, would_be) is None
+        assert load_offer(app, a1, ids[0]) is None  # first taken
+        for oid in ids[1:]:
+            assert load_offer(app, a1, oid).offer.amount == 100 * M
+
+    def test_takes_multiple_offers_and_is_cleared(self, app, book):
+        """1010 USD at 1/2 crosses 6 full offers + part of the 7th; the
+        seller-biased big_divide rounding decides the partial amount
+        (OfferTests.cpp:547-637)."""
+        r, gw, a1, idr, usd, ids, price = book
+        a1_usd = line_balance(app, a1, usd)
+        a1_idr = line_balance(app, a1, idr)
+        b1 = make_b1(app, r, gw, idr, usd, 20000 * M)
+        b1_usd = line_balance(app, b1, usd)
+        b1_idr = line_balance(app, b1, idr)
+        would_be = last_generated_id(app) + 1
+        eff, _, _ = apply_offer(b1, usd, idr, X.Price(1, 2), 1010 * M)
+        assert eff == EF.MANAGE_OFFER_DELETED
+        assert load_offer(app, b1, would_be) is None
+        usd_recv = 1010 * M
+        idr_send = usd_recv * 2 // 3  # bigDivide(usdRecv, 2, 3)
+        for i, oid in enumerate(ids):
+            if i < 6:
+                assert load_offer(app, a1, oid) is None
+            elif i == 6:
+                expected = 100 * M - (idr_send - 6 * 100 * M)
+                check_amounts(expected, load_offer(app, a1, oid).offer.amount)
+            else:
+                assert load_offer(app, a1, oid).offer.amount == 100 * M
+        check_amounts(a1_usd + usd_recv, line_balance(app, a1, usd))
+        check_amounts(a1_idr - idr_send, line_balance(app, a1, idr))
+        # buyer may pay a bit more crossing offers
+        check_amounts(line_balance(app, b1, usd), b1_usd - usd_recv)
+        check_amounts(line_balance(app, b1, idr), b1_idr + idr_send)
+
+    def test_cannot_extract_value_with_tiny_offers(self, app, book):
+        """Ten 1-USD crossings must not round value away from the resting
+        seller (OfferTests.cpp:639-699)."""
+        r, gw, a1, idr, usd, ids, price = book
+        a1_usd = line_balance(app, a1, usd)
+        a1_idr = line_balance(app, a1, idr)
+        b1 = make_b1(app, r, gw, idr, usd, 20000 * M)
+        b1_usd = line_balance(app, b1, usd)
+        b1_idr = line_balance(app, b1, idr)
+        for _ in range(10):
+            would_be = last_generated_id(app) + 1
+            eff, _, _ = apply_offer(b1, usd, idr, X.Price(1, 2), 1 * M)
+            assert eff == EF.MANAGE_OFFER_DELETED
+            assert load_offer(app, b1, would_be) is None
+        usd_recv = 10 * M
+        idr_send = usd_recv * 2 // 3
+        check_amounts(100 * M - idr_send,
+                      load_offer(app, a1, ids[0]).offer.amount, 10)
+        for oid in ids[1:]:
+            assert load_offer(app, a1, oid).offer.amount == 100 * M
+        check_amounts(a1_usd + usd_recv, line_balance(app, a1, usd), 10)
+        check_amounts(a1_idr - idr_send, line_balance(app, a1, idr), 10)
+        check_amounts(line_balance(app, b1, usd), b1_usd - usd_recv, 10)
+        check_amounts(line_balance(app, b1, idr), b1_idr + idr_send, 10)
+
+    def test_takes_multiple_offers_and_remains(self, app, book):
+        """10000 USD sweeps all 22 offers plus a drained bogus offer, and
+        the remainder rests (OfferTests.cpp:701-780)."""
+        r, gw, a1, idr, usd, ids, price = book
+        a1_usd = line_balance(app, a1, usd)
+        a1_idr = line_balance(app, a1, idr)
+        b1 = make_b1(app, r, gw, idr, usd, 20000 * M)
+        b1_usd = line_balance(app, b1, usd)
+        b1_idr = line_balance(app, b1, idr)
+        # bogus offer from c1, then drain c1's IDR so it can't deliver
+        min3 = app.ledger_manager.get_min_balance(3)
+        c1 = mk_account(app, r, T.get_account(3), min3 + 10000)
+        trust_and_fund(app, gw, c1, idr, b"IDR", 20000 * M)
+        trust_and_fund(app, gw, c1, usd, b"USD", 0)
+        eff, c_entry, _ = apply_offer(c1, idr, usd, price, 100 * M)
+        assert eff == EF.MANAGE_OFFER_CREATED
+        c1.apply([T.payment_op(gw.key, 20000 * M, asset=idr)])
+        assert load_offer(app, c1, c_entry.offerID) is not None
+
+        eff, entry, _ = apply_offer(b1, usd, idr, X.Price(1, 2), 10000 * M)
+        assert eff == EF.MANAGE_OFFER_CREATED
+        usd_recv = 150 * M * len(ids)
+        idr_send = usd_recv * 2 // 3
+        check_amounts(10000 * M - usd_recv,
+                      load_offer(app, b1, entry.offerID).offer.amount)
+        assert load_offer(app, c1, c_entry.offerID) is None  # bogus cleared
+        for oid in ids:
+            assert load_offer(app, a1, oid) is None
+        check_amounts(a1_usd + usd_recv, line_balance(app, a1, usd))
+        check_amounts(a1_idr - idr_send, line_balance(app, a1, idr))
+        check_amounts(line_balance(app, b1, usd), b1_usd - usd_recv)
+        check_amounts(line_balance(app, b1, idr), b1_idr + idr_send)
+
+
+@pytest.fixture
+def limits_world(app, root, world):
+    """a1 with one resting offer: sell 100 IDR for 150 USD
+    (OfferTests.cpp:781-795)."""
+    r, gw, idr, usd, min2 = world
+    min_a = app.ledger_manager.get_min_balance(3 + 22)
+    a1 = mk_account(app, r, T.get_account(1), min_a + 10000)
+    trust_and_fund(app, gw, a1, usd, b"USD", 0)
+    trust_and_fund(app, gw, a1, idr, b"IDR", TL_BALANCE)
+    eff, entry, _ = apply_offer(a1, idr, usd, X.Price(3, 2), 100 * M)
+    assert eff == EF.MANAGE_OFFER_CREATED
+    return r, gw, a1, idr, usd, entry.offerID
+
+
+class TestLimitsAndIssuers:
+    """OfferTests.cpp:781-1135."""
+
+    def _add_seller(self, app, r, gw, idr, usd, n, amount=TL_BALANCE):
+        min3 = app.ledger_manager.get_min_balance(3)
+        acct = mk_account(app, r, T.get_account(n), min3 + 10000)
+        trust_and_fund(app, gw, acct, idr, b"IDR", amount)
+        trust_and_fund(app, gw, acct, usd, b"USD", 0)
+        return acct
+
+    def test_buyer_reaches_line_limit_mid_cross(self, app, limits_world):
+        """C's IDR line has only 150 IDR of headroom: A taken fully, B
+        partially, C's leftover not created (OfferTests.cpp:797-858)."""
+        r, gw, a1, idr, usd, offer_a = limits_world
+        b1 = self._add_seller(app, r, gw, idr, usd, 2)
+        eff, entry_b, _ = apply_offer(b1, idr, usd, X.Price(3, 2), 100 * M)
+        assert eff == EF.MANAGE_OFFER_CREATED
+        min_a = app.ledger_manager.get_min_balance(3 + 22)
+        c1 = mk_account(app, r, T.get_account(3), min_a + 10000)
+        trust_and_fund(app, gw, c1, usd, b"USD", TL_BALANCE)
+        trust_and_fund(app, gw, c1, idr, b"IDR",
+                       TL_LIMIT - 150 * M)
+        eff, _, _ = apply_offer(c1, usd, idr, X.Price(2, 3), 300 * M)
+        assert eff == EF.MANAGE_OFFER_DELETED
+        check_amounts(150 * M, line_balance(app, a1, usd))
+        check_amounts(TL_BALANCE - 100 * M, line_balance(app, a1, idr))
+        check_amounts(line_balance(app, b1, usd), 75 * M)
+        check_amounts(line_balance(app, b1, idr), TL_BALANCE - 50 * M)
+        check_amounts(line_balance(app, c1, usd), TL_BALANCE - 225 * M)
+        check_amounts(line_balance(app, c1, idr), TL_LIMIT)
+
+    @pytest.mark.parametrize("revoked_code", [b"USD", b"IDR"])
+    def test_unauthorized_top_seller_skipped(self, app, root, world,
+                                             revoked_code):
+        """AUTH_REQUIRED gateway; D's auth then revoked: crossing skips D's
+        offer (deleting it) and fills from E (OfferTests.cpp:860-997)."""
+        r, gw, _, _, min2 = world
+        sec_key = T.get_account(102)
+        sec = mk_account(app, r, sec_key, min2)
+        flags = int(X.AccountFlags.AUTH_REQUIRED_FLAG) | int(
+            X.AccountFlags.AUTH_REVOCABLE_FLAG)
+        sec.apply([T.set_options_op(set_flags=flags)])
+        sidr = X.Asset.alphanum4(b"IDR", sec_key.get_public_key())
+        susd = X.Asset.alphanum4(b"USD", sec_key.get_public_key())
+        min3 = app.ledger_manager.get_min_balance(3)
+
+        def setup(n, fund_asset, fund_code):
+            acct = mk_account(app, r, T.get_account(n), min3 + 10000)
+            acct.apply([T.change_trust_op(sidr, TL_LIMIT)])
+            acct.apply([T.change_trust_op(susd, TL_LIMIT)])
+            sec.apply([T.allow_trust_op(acct.key, b"USD", True)])
+            sec.apply([T.allow_trust_op(acct.key, b"IDR", True)])
+            sec.apply([T.payment_op(acct.key, TL_BALANCE, asset=fund_asset)])
+            return acct
+
+        d1 = setup(4, sidr, b"IDR")
+        eff, d_entry, _ = apply_offer(d1, sidr, susd, X.Price(3, 2), 100 * M)
+        assert eff == EF.MANAGE_OFFER_CREATED
+        sec.apply([T.allow_trust_op(d1.key, revoked_code, False)])
+        e1 = setup(5, sidr, b"IDR")
+        eff, e_entry, _ = apply_offer(e1, sidr, susd, X.Price(3, 2), 100 * M)
+        assert eff == EF.MANAGE_OFFER_CREATED
+        f1 = setup(6, susd, b"USD")
+        eff, f_entry, _ = apply_offer(f1, susd, sidr, X.Price(2, 3), 300 * M)
+        assert eff == EF.MANAGE_OFFER_CREATED
+        assert f_entry.amount == 150 * M
+        # D's offer deleted without filling
+        assert load_offer(app, d1, d_entry.offerID) is None
+        check_amounts(0, line_balance(app, d1, susd))
+        check_amounts(TL_BALANCE, line_balance(app, d1, sidr))
+        # E's offer fully taken
+        assert load_offer(app, e1, e_entry.offerID) is None
+        check_amounts(line_balance(app, e1, susd), 150 * M)
+        check_amounts(line_balance(app, e1, sidr), TL_BALANCE - 100 * M)
+        check_amounts(line_balance(app, f1, susd), TL_BALANCE - 150 * M)
+        check_amounts(line_balance(app, f1, sidr), 100 * M)
+
+    def test_top_seller_usd_line_fills_up(self, app, limits_world):
+        """A can only hold 75 more USD: crossing takes B fully, A partially,
+        leftover rests (OfferTests.cpp:999-1056)."""
+        r, gw, a1, idr, usd, offer_a = limits_world
+        b1 = self._add_seller(app, r, gw, idr, usd, 2)
+        eff, entry_b, _ = apply_offer(b1, idr, usd, X.Price(3, 2), 100 * M)
+        assert eff == EF.MANAGE_OFFER_CREATED
+        min_a = app.ledger_manager.get_min_balance(3 + 22)
+        c1 = mk_account(app, r, T.get_account(3), min_a + 10000)
+        trust_and_fund(app, gw, c1, usd, b"USD", TL_BALANCE)
+        trust_and_fund(app, gw, c1, idr, b"IDR", 0)
+        # cap A's USD headroom at 75
+        gw.apply([T.payment_op(a1.key, TL_LIMIT - 75 * M, asset=usd)])
+        eff, entry_c, _ = apply_offer(c1, usd, idr, X.Price(2, 3), 300 * M)
+        assert eff == EF.MANAGE_OFFER_CREATED
+        assert entry_c.amount == 75 * M
+        assert load_offer(app, a1, offer_a) is None
+        check_amounts(TL_LIMIT, line_balance(app, a1, usd))
+        check_amounts(TL_BALANCE - 50 * M, line_balance(app, a1, idr))
+        assert load_offer(app, b1, entry_b.offerID) is None
+        check_amounts(line_balance(app, b1, usd), 150 * M)
+        check_amounts(line_balance(app, b1, idr), TL_BALANCE - 100 * M)
+        check_amounts(line_balance(app, c1, usd), TL_BALANCE - 225 * M)
+        check_amounts(line_balance(app, c1, idr), 150 * M)
+
+    def test_issuer_offer_claimed_by_other(self, app, limits_world):
+        """Issuer sells its own asset; buyer's payment to the issuer burns
+        (OfferTests.cpp:1058-1090)."""
+        r, gw, a1, idr, usd, offer_a = limits_world
+        gw_offer_id = last_generated_id(app) + 1
+        eff, entry, _ = apply_offer(gw, idr, usd, X.Price(9, 10), 100 * M)
+        assert eff == EF.MANAGE_OFFER_CREATED
+        gw.apply([T.payment_op(a1.key, 1000 * M, asset=usd)])
+        eff, _, _ = apply_offer(a1, usd, idr, X.Price(1, 1), 90 * M)
+        assert eff == EF.MANAGE_OFFER_DELETED
+        assert load_offer(app, gw, gw_offer_id) is None
+        check_amounts(910 * M, line_balance(app, a1, usd))
+        check_amounts(TL_BALANCE + 100 * M, line_balance(app, a1, idr))
+
+    def test_issuer_claims_offer(self, app, limits_world):
+        """Issuer buys back its own asset (OfferTests.cpp:1091-1112)."""
+        r, gw, a1, idr, usd, offer_a = limits_world
+        eff, _, _ = apply_offer(gw, usd, idr, X.Price(2, 3), 150 * M)
+        assert eff == EF.MANAGE_OFFER_DELETED
+        assert load_offer(app, a1, offer_a) is None
+        check_amounts(150 * M, line_balance(app, a1, usd))
+        check_amounts(TL_BALANCE - 100 * M, line_balance(app, a1, idr))
+
+
+class TestNativeOffers:
+    """OfferTests.cpp:365-381 — offers against the native asset."""
+
+    @pytest.mark.parametrize("direction", ["idr_for_xlm", "xlm_for_idr"])
+    def test_native_offer_created(self, app, root, world, direction):
+        r, gw, idr, usd, min2 = world
+        min_a = app.ledger_manager.get_min_balance(3 + 22)
+        a1 = mk_account(app, r, T.get_account(1), min_a + 10000)
+        trust_and_fund(app, gw, a1, usd, b"USD", 0)
+        trust_and_fund(app, gw, a1, idr, b"IDR", TL_BALANCE)
+        xlm = X.Asset.native()
+        if direction == "idr_for_xlm":
+            selling, buying = xlm, idr
+        else:
+            selling, buying = idr, xlm
+        eff, entry, _ = apply_offer(
+            a1, selling, buying, X.Price(3, 2), 100 * M
+        )
+        assert eff == EF.MANAGE_OFFER_CREATED
+        o = load_offer(app, a1, entry.offerID).offer
+        assert o.selling == selling and o.buying == buying

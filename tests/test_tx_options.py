@@ -1,0 +1,264 @@
+"""SetOptions / AccountMerge / ChangeTrust edge corpus (reference:
+src/transactions/SetOptionsTests.cpp, MergeTests.cpp, ChangeTrustTests.cpp).
+
+Covers the edges test_tx.py leaves open: signer lifecycle (add/update/
+remove, reserve gating, master-key rejection), flag arithmetic (set+clear
+conflict, AUTH_IMMUTABLE latching), home-domain validation, merge failure
+codes (self, ghost dest, immutable, sub-entries incl. offers), the
+merge-invalidates-dependent-tx close, and trust-limit invariants.
+"""
+
+import pytest
+
+import stellar_tpu.xdr as X
+from stellar_tpu.ledger.accountframe import AccountFrame
+from stellar_tpu.ledger.trustframe import TrustFrame
+from stellar_tpu.main.application import Application
+from stellar_tpu.tx import testutils as T
+from stellar_tpu.util import VIRTUAL_TIME, VirtualClock
+
+RC = X.TransactionResultCode
+SOC = X.SetOptionsResultCode
+AMC = X.AccountMergeResultCode
+CTC = X.ChangeTrustResultCode
+
+M = 1_000_000
+
+
+@pytest.fixture
+def clock():
+    c = VirtualClock(VIRTUAL_TIME)
+    yield c
+    c.shutdown()
+
+
+@pytest.fixture
+def app(clock):
+    a = Application(clock, T.get_test_config(), new_db=True)
+    yield a
+    a.database.close()
+
+
+@pytest.fixture
+def root(app):
+    return T.root_key_for(app)
+
+
+def seq_of(app, key):
+    return AccountFrame.load_account(
+        key.get_public_key(), app.database
+    ).get_seq_num()
+
+
+def apply_one(app, source, op_, expect=RC.txSUCCESS):
+    tx = T.tx_from_ops(app, source, seq_of(app, source) + 1, [op_])
+    T.apply_tx(app, tx, expect_code=expect)
+    return tx
+
+
+def fund(app, root, dest, amount):
+    apply_one(app, root, T.create_account_op(dest, amount))
+    return dest
+
+
+def signers_of(app, key):
+    return AccountFrame.load_account(
+        key.get_public_key(), app.database
+    ).account.signers
+
+
+class TestSetOptionsSigners:
+    """SetOptionsTests.cpp:50-133."""
+
+    @pytest.fixture
+    def a1(self, app, root):
+        return fund(app, root, T.get_account(1),
+                    app.ledger_manager.get_min_balance(0) + 1000)
+
+    def test_signer_needs_reserve(self, app, root, a1):
+        s1 = T.get_account(11)
+        tx = apply_one(app, a1, T.set_options_op(
+            master_weight=100, low=1, med=10, high=100,
+            signer=X.Signer(s1.get_public_key(), 1),
+        ), expect=RC.txFAILED)
+        assert T.inner_op_code(tx) == SOC.SET_OPTIONS_LOW_RESERVE
+
+    def test_master_key_cannot_be_signer(self, app, root, a1):
+        tx = apply_one(app, a1, T.set_options_op(
+            signer=X.Signer(a1.get_public_key(), 100),
+        ), expect=RC.txFAILED)
+        assert T.inner_op_code(tx) == SOC.SET_OPTIONS_BAD_SIGNER
+
+    def test_signer_lifecycle(self, app, root, a1):
+        """Add two signers, update both weights, remove both via weight 0
+        (SetOptionsTests.cpp:75-133)."""
+        apply_one(app, root, T.payment_op(
+            a1, app.ledger_manager.get_min_balance(2)))
+        s1, s2 = T.get_account(11), T.get_account(12)
+        apply_one(app, a1, T.set_options_op(
+            master_weight=100, low=1, med=10, high=100,
+            signer=X.Signer(s1.get_public_key(), 1),
+        ))
+        sg = signers_of(app, a1)
+        assert len(sg) == 1
+        assert sg[0].pubKey == s1.get_public_key() and sg[0].weight == 1
+        apply_one(app, a1, T.set_options_op(
+            signer=X.Signer(s2.get_public_key(), 100)))
+        assert len(signers_of(app, a1)) == 2
+        apply_one(app, a1, T.set_options_op(
+            signer=X.Signer(s2.get_public_key(), 11)))
+        apply_one(app, a1, T.set_options_op(
+            signer=X.Signer(s1.get_public_key(), 11)))
+        apply_one(app, a1, T.set_options_op(
+            signer=X.Signer(s1.get_public_key(), 0)))  # remove s1
+        sg = signers_of(app, a1)
+        assert len(sg) == 1
+        assert sg[0].pubKey == s2.get_public_key() and sg[0].weight == 11
+        apply_one(app, a1, T.set_options_op(
+            signer=X.Signer(s2.get_public_key(), 0)))  # remove s2
+        assert signers_of(app, a1) == []
+
+
+class TestSetOptionsFlags:
+    """SetOptionsTests.cpp:134-177."""
+
+    @pytest.fixture
+    def a1(self, app, root):
+        return fund(app, root, T.get_account(1),
+                    app.ledger_manager.get_min_balance(0) + 1000)
+
+    def test_set_and_clear_same_flag_rejected(self, app, root, a1):
+        f = int(X.AccountFlags.AUTH_REQUIRED_FLAG)
+        tx = apply_one(app, a1, T.set_options_op(set_flags=f, clear_flags=f),
+                       expect=RC.txFAILED)
+        assert T.inner_op_code(tx) == SOC.SET_OPTIONS_BAD_FLAGS
+
+    def test_immutable_latches_all_auth_flags(self, app, root, a1):
+        req = int(X.AccountFlags.AUTH_REQUIRED_FLAG)
+        rev = int(X.AccountFlags.AUTH_REVOCABLE_FLAG)
+        imm = int(X.AccountFlags.AUTH_IMMUTABLE_FLAG)
+        apply_one(app, a1, T.set_options_op(set_flags=req))
+        apply_one(app, a1, T.set_options_op(set_flags=rev))
+        apply_one(app, a1, T.set_options_op(clear_flags=rev))
+        apply_one(app, a1, T.set_options_op(set_flags=imm))
+        for op_ in (
+            T.set_options_op(clear_flags=imm),
+            T.set_options_op(clear_flags=req),
+            T.set_options_op(set_flags=rev),
+        ):
+            tx = apply_one(app, a1, op_, expect=RC.txFAILED)
+            assert T.inner_op_code(tx) == SOC.SET_OPTIONS_CANT_CHANGE
+
+    @pytest.mark.parametrize(
+        "domain", ["abc\r", "abc\x7f", "ab\x00c"]
+    )
+    def test_invalid_home_domain(self, app, root, a1, domain):
+        tx = apply_one(app, a1, T.set_options_op(home_domain=domain),
+                       expect=RC.txFAILED)
+        assert T.inner_op_code(tx) == SOC.SET_OPTIONS_INVALID_HOME_DOMAIN
+
+
+class TestAccountMerge:
+    """MergeTests.cpp."""
+
+    @pytest.fixture
+    def world(self, app, root):
+        lm = app.ledger_manager
+        min_balance = lm.get_min_balance(5) + 20 * lm.get_tx_fee()
+        a1 = fund(app, root, T.get_account(1), min_balance)
+        return a1, min_balance
+
+    def test_merge_into_self_malformed(self, app, root, world):
+        a1, _ = world
+        tx = apply_one(app, a1, T.merge_op(a1), expect=RC.txFAILED)
+        assert T.inner_op_code(tx) == AMC.ACCOUNT_MERGE_MALFORMED
+
+    def test_merge_into_ghost_no_account(self, app, root, world):
+        a1, _ = world
+        tx = apply_one(app, a1, T.merge_op(T.get_account(2)),
+                       expect=RC.txFAILED)
+        assert T.inner_op_code(tx) == AMC.ACCOUNT_MERGE_NO_ACCOUNT
+
+    def test_merge_immutable_rejected(self, app, root, world):
+        a1, min_balance = world
+        b1 = fund(app, root, T.get_account(2), min_balance)
+        apply_one(app, a1, T.set_options_op(
+            set_flags=int(X.AccountFlags.AUTH_IMMUTABLE_FLAG)))
+        tx = apply_one(app, a1, T.merge_op(b1), expect=RC.txFAILED)
+        assert T.inner_op_code(tx) == AMC.ACCOUNT_MERGE_IMMUTABLE_SET
+
+    def test_merge_with_offer_subentries_rejected(self, app, root, world):
+        """MergeTests.cpp:95-118 — even after the trust line is emptied and
+        deleted, resting offers keep the account un-mergeable."""
+        a1, min_balance = world
+        b1 = fund(app, root, T.get_account(2), min_balance)
+        gw = fund(app, root, T.get_account(3), min_balance)
+        usd = X.Asset.alphanum4(b"USD", gw.get_public_key())
+        apply_one(app, a1, T.change_trust_op(usd, 10_000_000 * M))
+        apply_one(app, gw, T.payment_op(a1, 100_000 * M, asset=usd))
+        for _ in range(4):
+            apply_one(app, a1, T.manage_offer_op(
+                X.Asset.native(), usd, 100 * M, X.Price(3, 2)))
+        apply_one(app, a1, T.payment_op(gw, 100_000 * M, asset=usd))
+        apply_one(app, a1, T.change_trust_op(usd, 0))
+        tx = apply_one(app, a1, T.merge_op(b1), expect=RC.txFAILED)
+        assert T.inner_op_code(tx) == AMC.ACCOUNT_MERGE_HAS_SUB_ENTRIES
+
+    def test_merge_invalidates_dependent_tx_in_close(self, app, root, world):
+        """MergeTests.cpp:127-151 — tx1 merges a1 away, tx2 (from a1) then
+        reports txNO_ACCOUNT; b1 ends with both balances minus both fees."""
+        a1, min_balance = world
+        b1 = fund(app, root, T.get_account(2), min_balance)
+        lm = app.ledger_manager
+        seq = seq_of(app, a1)
+        tx1 = T.tx_from_ops(app, a1, seq + 1, [T.merge_op(b1)])
+        tx2 = T.tx_from_ops(app, a1, seq + 2, [T.payment_op(root, 100)])
+
+        from stellar_tpu.herder.txset import TxSetFrame
+
+        txset = TxSetFrame(lm.last_closed.hash, [tx1, tx2])
+        txset.sort_for_hash()
+        assert txset.check_valid(app)
+        a1_balance = min_balance
+        b1_balance = min_balance
+        T.close_ledger_on(
+            app, lm.last_closed.header.scpValue.closeTime + 5, [tx1, tx2]
+        )
+        assert tx1.get_result_code() == RC.txSUCCESS
+        assert tx2.get_result_code() == RC.txNO_ACCOUNT
+        assert AccountFrame.load_account(
+            a1.get_public_key(), app.database) is None
+        expected = a1_balance + b1_balance - 2 * lm.get_tx_fee()
+        assert AccountFrame.load_account(
+            b1.get_public_key(), app.database).get_balance() == expected
+
+
+class TestChangeTrustLimits:
+    """ChangeTrustTests.cpp:36-92."""
+
+    def test_limit_vs_balance_invariants(self, app, root):
+        lm = app.ledger_manager
+        gw = fund(app, root, T.get_account(1), lm.get_min_balance(2))
+        idr = X.Asset.alphanum4(b"IDR", gw.get_public_key())
+
+        tx = apply_one(app, root, T.change_trust_op(idr, 0),
+                       expect=RC.txFAILED)
+        assert T.inner_op_code(tx) == CTC.CHANGE_TRUST_INVALID_LIMIT
+        apply_one(app, root, T.change_trust_op(idr, 100))
+        apply_one(app, gw, T.payment_op(root, 90, asset=idr))
+        for bad_limit in (89, 0):  # below balance / delete with balance
+            tx = apply_one(app, root, T.change_trust_op(idr, bad_limit),
+                           expect=RC.txFAILED)
+            assert T.inner_op_code(tx) == CTC.CHANGE_TRUST_INVALID_LIMIT
+        apply_one(app, root, T.change_trust_op(idr, 90))  # at balance: ok
+        apply_one(app, root, T.payment_op(gw, 90, asset=idr))
+        apply_one(app, root, T.change_trust_op(idr, 0))  # now deletable
+        assert TrustFrame.load_trust_line(
+            root.get_public_key(), idr, app.database) is None
+
+    def test_new_line_requires_live_issuer(self, app, root):
+        ghost_issuer = T.get_account(9)
+        usd = X.Asset.alphanum4(b"USD", ghost_issuer.get_public_key())
+        tx = apply_one(app, root, T.change_trust_op(usd, 100),
+                       expect=RC.txFAILED)
+        assert T.inner_op_code(tx) == CTC.CHANGE_TRUST_NO_ISSUER
