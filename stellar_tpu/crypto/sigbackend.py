@@ -60,11 +60,42 @@ class CachingSigBackend(SigBackend):
         return self.inner.stats()
 
 
+_pool = None
+
+
 def _sodium_verify_loop(items: Sequence[VerifyTriple]) -> List[bool]:
     """One libsodium verify per triple — the reference's exact behavior
     (crypto_sign_verify_detached, SecretKey.cpp:277-279).  Shared by the
-    cpu backend and the tpu backend's small-batch cutover."""
-    return [sodium.verify_detached(sig, msg, pk) for pk, msg, sig in items]
+    cpu backend and the tpu backend's small-batch cutover.
+
+    Large batches fan out over a thread pool when the host has spare
+    cores: the ctypes call releases the GIL, so verification scales
+    near-linearly (the reference stays single-threaded here; our batch
+    abstraction makes the parallelism free).  Single-core hosts and small
+    batches keep the plain loop."""
+    import os
+
+    n = len(items)
+    workers = min(8, os.cpu_count() or 1)
+    if n < 256 or workers < 2:
+        return [sodium.verify_detached(sig, msg, pk) for pk, msg, sig in items]
+    global _pool
+    if _pool is None:
+        from concurrent.futures import ThreadPoolExecutor
+
+        _pool = ThreadPoolExecutor(
+            max_workers=workers, thread_name_prefix="sodium-verify"
+        )
+    chunk = (n + workers - 1) // workers
+
+    def run(lo):
+        return [
+            sodium.verify_detached(sig, msg, pk)
+            for pk, msg, sig in items[lo : lo + chunk]
+        ]
+
+    parts = list(_pool.map(run, range(0, n, chunk)))
+    return [ok for part in parts for ok in part]
 
 
 class CpuSigBackend(SigBackend):

@@ -29,21 +29,21 @@ _lib = None
 _tried = False
 
 
-def _build() -> bool:
+def _compile_so(src: str, so: str, extra_flags: Sequence[str] = ()) -> bool:
     # per-process temp name: concurrent first-use builds in sibling
     # processes must not interleave writes into one file
-    tmp = f"{_SO}.{os.getpid()}.tmp"
+    tmp = f"{so}.{os.getpid()}.tmp"
     for cc in ("cc", "gcc", "clang"):
         try:
             r = subprocess.run(
-                [cc, "-O2", "-shared", "-fPIC", "-o", tmp, _SRC],
+                [cc, "-O2", "-shared", "-fPIC", *extra_flags, "-o", tmp, src],
                 capture_output=True,
                 timeout=120,
             )
         except (OSError, subprocess.TimeoutExpired):
             continue
         if r.returncode == 0:
-            os.replace(tmp, _SO)
+            os.replace(tmp, so)
             return True
     try:
         os.unlink(tmp)
@@ -52,15 +52,28 @@ def _build() -> bool:
     return False
 
 
+def _needs_build(src: str, so: str) -> bool:
+    """True when the .so must be (re)built.  A prebuilt .so with no source
+    next to it (source-stripped deployment) is used as-is."""
+    if not os.path.exists(so):
+        return True
+    try:
+        return os.path.getmtime(so) < os.path.getmtime(src)
+    except OSError:
+        return False
+
+
+def _build() -> bool:
+    return _compile_so(_SRC, _SO)
+
+
 def _load():
     global _lib, _tried
     with _lock:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(
-            _SRC
-        ):
+        if _needs_build(_SRC, _SO):
             if not _build():
                 return None
         try:
@@ -133,3 +146,51 @@ def sha256_file(path: str) -> Optional[bytes]:
     if lib.sha256_file(path.encode(), out) != 0:
         return None
     return bytes(out)
+
+
+# -- cxdrpack: the C XDR pack interpreter (CPython extension) ---------------
+
+_CXDR_SRC = os.path.join(_HERE, "cxdrpack.c")
+_CXDR_SO = os.path.join(_HERE, "_cxdrpack.so")
+
+_cxdr_lock = threading.Lock()
+_cxdr_mod = None
+_cxdr_tried = False
+
+
+def _build_cxdrpack() -> bool:
+    import sysconfig
+
+    inc = sysconfig.get_paths()["include"]
+    return _compile_so(_CXDR_SRC, _CXDR_SO, (f"-I{inc}",))
+
+
+def load_cxdrpack():
+    """The compiled C pack interpreter module, or None (pure-Python
+    fallback).  Built on first use like the merge engine above; the
+    unresolved CPython symbols bind into the running interpreter at
+    dlopen time, so no libpython link is needed."""
+    global _cxdr_mod, _cxdr_tried
+    with _cxdr_lock:
+        if _cxdr_mod is not None or _cxdr_tried:
+            return _cxdr_mod
+        _cxdr_tried = True
+        if _needs_build(_CXDR_SRC, _CXDR_SO):
+            if not _build_cxdrpack():
+                return None
+        try:
+            import importlib.machinery
+            import importlib.util
+
+            loader = importlib.machinery.ExtensionFileLoader(
+                "_cxdrpack", _CXDR_SO
+            )
+            spec = importlib.util.spec_from_file_location(
+                "_cxdrpack", _CXDR_SO, loader=loader
+            )
+            mod = importlib.util.module_from_spec(spec)
+            loader.exec_module(mod)
+            _cxdr_mod = mod
+        except (ImportError, OSError):
+            return None
+        return _cxdr_mod

@@ -25,10 +25,11 @@ from ..crypto import PubKeyUtils, sha256
 from ..crypto.keys import SecretKey
 from ..ledger.accountframe import AccountFrame
 from ..ledger.delta import LedgerDelta
+from .opframe import OperationFrame
 from ..util.xmath import INT64_MAX
 from ..xdr.base import xdr_to_opaque
 from ..xdr.entries import EnvelopeType, PublicKey, Signer
-from ..xdr.ledger import TransactionResultPair, TransactionMeta
+from ..xdr.ledger import OperationMeta, TransactionResultPair, TransactionMeta
 from ..xdr.overlay import MessageType, StellarMessage
 from ..xdr.txs import (
     DecoratedSignature,
@@ -116,8 +117,6 @@ class TransactionFrame:
 
     # -- results -----------------------------------------------------------
     def reset_results(self):
-        from .opframe import OperationFrame
-
         op_results = []
         for op in self.envelope.tx.operations:
             op_results.append(OperationResult(None, None))  # filled by op frames
@@ -327,8 +326,6 @@ class TransactionFrame:
         stray_signatures = False
         db = app.database
         op_timer = app.metrics.new_timer(("transaction", "op", "apply"))
-        from ..xdr.ledger import OperationMeta
-
         this_tx_delta = LedgerDelta(outer=delta)
         try:
             with db.transaction():

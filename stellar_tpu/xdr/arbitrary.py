@@ -81,7 +81,9 @@ def _gen(codec: XdrCodec, size: int, rng: random.Random) -> Any:
     if isinstance(codec, _UnionCodec):
         # normalized arms map disc -> codec-or-None(void); stick to known
         # arms unless the union tolerates unknown discriminants
-        if codec.default_void and rng.random() < 0.1:
+        if not codec.arms or (codec.default_void and rng.random() < 0.1):
+            # zero declared arms (e.g. AllowTrustResult: every code is
+            # void) or an unknown-tolerant union probing a random value
             disc = _gen(codec.switch_codec, size, rng)
         else:
             disc = rng.choice(list(codec.arms))

@@ -62,6 +62,10 @@ class XdrCodec:
     # value-semantics), so xdr_copy may share them instead of rebuilding.
     immutable = False
 
+    # C fast path: None = not compiled yet, False = unsupported/unavailable,
+    # else a cxdrpack program capsule (see _compile_cprog)
+    _cprog = None
+
     def pack_into(self, val: Any, out: bytearray) -> None:
         raise NotImplementedError
 
@@ -75,7 +79,26 @@ class XdrCodec:
         round-trip per copy was ~25% of ledger-close time."""
         return val  # immutable leaf by default
 
+    def _compile_cprog(self):
+        mod = _cxdr()
+        if mod is None:
+            self._cprog = False
+            return False
+        try:
+            defs: List[Any] = []
+            root = _cspec_of(self, defs, {})
+            prog = mod.compile(defs, root, XdrError)
+        except _CUnsupported:
+            prog = False
+        self._cprog = prog
+        return prog
+
     def pack(self, val: Any) -> bytes:
+        prog = self._cprog
+        if prog is None:
+            prog = self._compile_cprog()
+        if prog is not False:
+            return _cxdr().pack(prog, val)
         out = bytearray()
         self.pack_into(val, out)
         return bytes(out)
@@ -757,5 +780,119 @@ def unpack_var_arrays(data: bytes, classes) -> Tuple[list, ...]:
 
 def xdr_copy(obj):
     """Codec-driven structural deep copy of any xstruct/xunion value —
-    equivalent to ``from_xdr(to_xdr(obj))`` without the serialization."""
-    return obj._codec.copy(obj)
+    equivalent to ``from_xdr(to_xdr(obj))`` without the serialization.
+    Takes the C fast path (native/cxdrpack.c copy_node — same sharing
+    semantics: immutable subtrees shared, containers rebuilt) when the
+    codec compiled; the ledger apply path copies entries/headers per
+    nested delta, so this is hot at close."""
+    codec = obj._codec
+    prog = codec._cprog
+    if prog is None:
+        prog = codec._compile_cprog()
+    if prog is not False:
+        return _cxdr().copy(prog, obj)
+    return codec.copy(obj)
+
+
+# -- C pack fast path -------------------------------------------------------
+#
+# The declarative codec tree compiles to a flat program interpreted by the
+# cxdrpack CPython extension (stellar_tpu/native/cxdrpack.c) — same octet
+# stream, same XdrError failure contract, ~an order of magnitude less pack
+# time (the pack layer was ~1.2 s of a 5000-tx ledger close).  Compilation
+# is lazy per codec; anything the C side does not model falls back to the
+# pure-Python pack_into path forever (codec._cprog = False).
+
+_cxdr_mod: Any = None
+_cxdr_checked = False
+
+
+def _cxdr():
+    global _cxdr_mod, _cxdr_checked
+    if not _cxdr_checked:
+        _cxdr_checked = True
+        try:
+            from ..native import load_cxdrpack
+
+            _cxdr_mod = load_cxdrpack()
+        except Exception:
+            _cxdr_mod = None
+    return _cxdr_mod
+
+
+class _CUnsupported(Exception):
+    """Codec shape the C interpreter does not model."""
+
+
+def _cspec_of(codec: XdrCodec, defs: List[Any], memo: Dict[int, int]) -> int:
+    """Append the compiled spec of `codec` (and its children) to `defs`,
+    returning its slot index.  `memo` closes recursive codec cycles
+    (SCPQuorumSet) by reserving the slot before descending."""
+    key = id(codec)
+    if key in memo:
+        return memo[key]
+    idx = len(defs)
+    memo[key] = idx
+    defs.append(None)  # reserved; filled below (recursion-safe)
+
+    if isinstance(codec, _UInt32):
+        spec: Any = ("u32",)
+    elif isinstance(codec, _Int32):
+        spec = ("i32",)
+    elif isinstance(codec, _UInt64):
+        spec = ("u64",)
+    elif isinstance(codec, _Int64):
+        spec = ("i64",)
+    elif isinstance(codec, _Bool):
+        spec = ("bool",)
+    elif isinstance(codec, _Enum):
+        spec = ("enum", tuple(sorted(codec.enum_cls._value2member_map_)))
+    elif isinstance(codec, _Opaque):
+        spec = ("opaque", codec.n)
+    elif isinstance(codec, _String):  # before _VarOpaque: subclass
+        spec = ("string", codec.maxlen)
+    elif isinstance(codec, _VarOpaque):
+        spec = ("varopaque", codec.maxlen)
+    elif isinstance(codec, _Array):
+        spec = ("array", codec.n, _cspec_of(codec.elem, defs, memo))
+    elif isinstance(codec, _VarArray):
+        spec = ("vararray", codec.maxlen, _cspec_of(codec.elem, defs, memo))
+    elif isinstance(codec, _Option):
+        spec = ("option", _cspec_of(codec.elem, defs, memo))
+    elif isinstance(codec, _StructCodec):
+        names = tuple(n for n, _ in codec.fields)
+        kids = tuple(_cspec_of(c, defs, memo) for _, c in codec.fields)
+        spec = ("struct", names, kids, codec.cls, int(codec.immutable))
+    elif isinstance(codec, _UnionCodec):
+        sw = codec.switch_codec
+        if isinstance(sw, _Enum):
+            sw_spec: Any = (
+                "enum",
+                tuple(sorted(sw.enum_cls._value2member_map_)),
+            )
+        elif isinstance(sw, _Int32):
+            sw_spec = ("i32",)
+        elif isinstance(sw, _UInt32):
+            sw_spec = ("u32",)
+        else:
+            raise _CUnsupported(f"union switch {type(sw).__name__}")
+        arms = {
+            int(disc): (-1 if c is None else _cspec_of(c, defs, memo))
+            for disc, c in codec.arms.items()
+        }
+        spec = (
+            "union", sw_spec, arms, int(codec.default_void), codec.cls,
+            int(codec.immutable),
+        )
+    elif isinstance(codec, DepthLimited):
+        if codec.inner is None:
+            raise _CUnsupported("DepthLimited with unbound inner")
+        spec = (
+            "depth",
+            codec.max_depth,
+            _cspec_of(codec.inner, defs, memo),
+        )
+    else:
+        raise _CUnsupported(type(codec).__name__)
+    defs[idx] = spec
+    return idx

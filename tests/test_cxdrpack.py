@@ -1,0 +1,194 @@
+"""Differential tests: the C pack interpreter (native/cxdrpack.c) vs the
+pure-Python codec (xdr/base.py) — byte-for-byte equality over every
+registered XDR type with fuzzed values, plus the failure contract (both
+paths raise XdrError for the same malformed inputs).
+
+Every hash in the system is a SHA-256 over these octets, so this is a
+consensus-critical equivalence (same bar as tests/test_native_merge.py for
+the C merge engine).
+"""
+
+import random
+
+import pytest
+
+import stellar_tpu.xdr as X
+from stellar_tpu.xdr import arbitrary
+from stellar_tpu.xdr.base import XdrError, codec_of, _cxdr
+
+cxdr = _cxdr()
+pytestmark = pytest.mark.skipif(
+    cxdr is None, reason="no C toolchain for cxdrpack"
+)
+
+
+def _registered_types():
+    """Every xstruct/xunion class exposed by the xdr package modules."""
+    import stellar_tpu.xdr.entries as entries
+    import stellar_tpu.xdr.ledger as ledger
+    import stellar_tpu.xdr.overlay as overlay
+    import stellar_tpu.xdr.scp as scp
+    import stellar_tpu.xdr.txs as txs
+    import stellar_tpu.xdr.xtypes as xtypes
+
+    out = []
+    for mod in (xtypes, entries, txs, ledger, scp, overlay):
+        for name in dir(mod):
+            cls = getattr(mod, name)
+            if isinstance(cls, type) and hasattr(cls, "_codec"):
+                out.append(cls)
+    # dedup by codec identity (re-exports)
+    seen, uniq = set(), []
+    for cls in out:
+        if id(cls._codec) not in seen:
+            seen.add(id(cls._codec))
+            uniq.append(cls)
+    return uniq
+
+
+TYPES = _registered_types()
+
+
+def _py_pack(codec, val) -> bytes:
+    out = bytearray()
+    codec.pack_into(val, out)
+    return bytes(out)
+
+
+def test_catalog_is_meaningful():
+    names = {c.__name__ for c in TYPES}
+    assert {
+        "TransactionEnvelope", "LedgerEntry", "TransactionMeta",
+        "SCPEnvelope", "StellarMessage", "LedgerHeader", "SCPQuorumSet",
+    } <= names
+    assert len(TYPES) > 40
+
+
+@pytest.mark.parametrize("cls", TYPES, ids=lambda c: c.__name__)
+def test_c_pack_matches_python_pack(cls):
+    rng = random.Random(hash(cls.__name__) & 0xFFFF)
+    codec = codec_of(cls)
+    for i in range(25):
+        val = arbitrary.arbitrary(codec, size=8, rng=rng)
+        expect = _py_pack(codec, val)
+        got = codec.pack(val)
+        if codec._cprog is False:
+            pytest.skip(f"{cls.__name__}: C compilation unsupported")
+        assert got == expect, f"{cls.__name__} iteration {i}"
+
+
+def test_all_catalog_types_compile_to_c():
+    """No silent fallback: every registered type must take the C path (a
+    new codec kind that can't compile should be a conscious decision)."""
+    for cls in TYPES:
+        codec = codec_of(cls)
+        codec.pack(arbitrary.arbitrary(codec, size=4, rng=random.Random(1)))
+        assert codec._cprog is not False, cls.__name__
+
+
+@pytest.mark.parametrize("cls", TYPES, ids=lambda c: c.__name__)
+def test_c_copy_matches_python_copy(cls):
+    """xdr_copy's C path: the copy packs to identical bytes, and mutable
+    values are truly independent of the original."""
+    from stellar_tpu.xdr.base import xdr_copy
+
+    rng = random.Random(hash(cls.__name__) & 0xFFF)
+    codec = codec_of(cls)
+    for _ in range(10):
+        val = arbitrary.arbitrary(codec, size=8, rng=rng)
+        dup = xdr_copy(val)
+        assert _py_pack(codec, dup) == _py_pack(codec, val)
+        if codec.immutable:
+            assert dup is val  # declared value-semantics: shared
+        else:
+            py_dup = codec.copy(val)
+            assert _py_pack(codec, py_dup) == _py_pack(codec, dup)
+
+
+def test_c_copy_is_independent():
+    from stellar_tpu.xdr.base import xdr_copy
+    from stellar_tpu.xdr.entries import AccountEntry
+
+    val = arbitrary.arbitrary_of(AccountEntry, size=6,
+                                 rng=random.Random(11))
+    dup = xdr_copy(val)
+    assert dup is not val
+    dup.balance = (val.balance or 0) + 7
+    assert val.balance != dup.balance
+    dup.signers.append("sentinel")
+    assert len(val.signers) == len(dup.signers) - 1
+
+
+class TestFailureContract:
+    def test_bad_enum_value(self):
+        env = X.TransactionEnvelope(
+            tx=None, signatures=[]
+        )
+        # malformed: tx must be a Transaction; C must raise XdrError too
+        with pytest.raises(XdrError):
+            codec_of(env).pack(env)
+
+    def test_short_opaque(self):
+        pk = X.PublicKey.from_ed25519(b"\x01" * 31)  # wrong length
+        with pytest.raises(XdrError):
+            codec_of(pk).pack(pk)
+
+    def test_void_arm_with_value(self):
+        a = X.Asset(X.AssetType.ASSET_TYPE_NATIVE, 123)
+        with pytest.raises(XdrError):
+            codec_of(a).pack(a)
+
+    def test_bad_union_discriminant(self):
+        a = X.Asset(9999, None)
+        with pytest.raises(XdrError):
+            codec_of(a).pack(a)
+
+    def test_unencodable_string_raises_xdr_error(self):
+        """A lone surrogate is a constructible str that cannot encode to
+        UTF-8: both paths must raise XdrError, not UnicodeEncodeError."""
+        from stellar_tpu.xdr.entries import AccountEntry
+
+        val = arbitrary.arbitrary_of(AccountEntry, size=4,
+                                     rng=random.Random(7))
+        val.homeDomain = "\ud800"
+        codec = codec_of(val)
+        with pytest.raises(XdrError):
+            codec.pack(val)  # C path
+        out = bytearray()
+        with pytest.raises(XdrError):
+            codec.pack_into(val, out)  # python path
+
+    def test_string_too_long(self):
+        from stellar_tpu.xdr.entries import AccountEntry
+
+        rng = random.Random(3)
+        val = arbitrary.arbitrary_of(AccountEntry, size=4, rng=rng)
+        val.homeDomain = "x" * 33
+        with pytest.raises(XdrError):
+            codec_of(val).pack(val)
+
+    def test_recursion_depth_bounded(self):
+        from stellar_tpu.xdr.scp import SCPQuorumSet
+
+        q = SCPQuorumSet(1, [], [])
+        for _ in range(10):  # deeper than the depth-8 guard
+            q = SCPQuorumSet(1, [], [q])
+        with pytest.raises(XdrError):
+            codec_of(q).pack(q)
+        # python path agrees
+        out = bytearray()
+        with pytest.raises(XdrError):
+            codec_of(q).pack_into(q, out)
+
+    def test_uint64_negative(self):
+        h = X.Price(1, 1)
+        c = codec_of(h)
+        bad = X.Price(-1, 1)  # int32 arm accepts -1; use uint64 type instead
+        from stellar_tpu.xdr.entries import AccountEntry
+
+        val = arbitrary.arbitrary_of(AccountEntry, size=4,
+                                     rng=random.Random(4))
+        val.balance = -5  # int64 ok; seqNum uint64? check via flags
+        val.flags = -1  # uint32 field
+        with pytest.raises(XdrError):
+            codec_of(val).pack(val)
