@@ -124,8 +124,173 @@ def main(n_txs=1000, n_ledgers=3):
         clock.shutdown()
 
 
-if __name__ == "__main__":
-    main(
-        int(sys.argv[1]) if len(sys.argv) > 1 else 1000,
-        int(sys.argv[2]) if len(sys.argv) > 2 else 3,
+def ladder(scales=(10**4, 10**5, 10**6), n_txs=5000, n_ledgers=3):
+    """Account-scale close ladder (reference shape:
+    LedgerPerformanceTests.cpp:149-225 — pre-create accounts, time the
+    close loop at each scale).
+
+    Each rung pre-populates `scale` accounts: 5001 real-keyed payment
+    participants plus synthetic bulk rows inserted directly (the reference
+    also pre-creates state outside the timed loop).  Payment destinations
+    are drawn uniformly from the WHOLE account range, so at 10^6 the
+    working set exceeds the 131,072-entry cache and the rung measures
+    cache-thrash + SQL load behavior, not just apply cost."""
+    import base64
+    import random
+
+    from stellar_tpu.crypto import strkey
+    from stellar_tpu.herder.ledgerclose import LedgerCloseData
+    from stellar_tpu.herder.txset import TxSetFrame
+    from stellar_tpu.ledger.accountframe import AccountFrame
+    from stellar_tpu.ledger.entryframe import entry_cache_of
+    from stellar_tpu.main.application import Application
+    from stellar_tpu.tx import testutils as T
+    from stellar_tpu.util.clock import VirtualClock
+    from stellar_tpu.xdr.base import xdr_to_opaque
+    from stellar_tpu.xdr.ledger import (
+        LedgerUpgrade,
+        LedgerUpgradeType,
+        StellarValue,
     )
+
+    thresholds_b64 = base64.b64encode(b"\x01\x00\x00\x00").decode()
+    results = []
+    for scale in scales:
+        cfg = T.get_test_config(95, backend="cpu")
+        cfg.DESIRED_MAX_TX_PER_LEDGER = n_txs * 2
+        clock = VirtualClock()
+        app = Application.create(clock, cfg, new_db=True)
+        try:
+            lm = app.ledger_manager
+            root = T.root_key_for(app)
+            up = xdr_to_opaque(
+                LedgerUpgrade(
+                    LedgerUpgradeType.LEDGER_UPGRADE_MAX_TX_SET_SIZE,
+                    n_txs * 2,
+                )
+            )
+            # real-keyed payment sources, created through actual closes
+            srcs = [T.get_account(i + 1) for i in range(n_txs + 1)]
+            seq = AccountFrame.load_account(
+                root.get_public_key(), app.database
+            ).get_seq_num()
+            upgrades = [up]
+            created_at = {}
+            for start in range(0, len(srcs), 2000):
+                batch = srcs[start : start + 2000]
+                txs = []
+                for i in range(0, len(batch), 100):
+                    seq += 1
+                    txs.append(
+                        T.tx_from_ops(
+                            app, root, seq,
+                            [T.create_account_op(a, 10**10)
+                             for a in batch[i : i + 100]],
+                        )
+                    )
+                txset = TxSetFrame(lm.last_closed.hash, txs)
+                txset.sort_for_hash()
+                assert txset.check_valid(app)
+                sv = StellarValue(
+                    txset.get_contents_hash(),
+                    lm.last_closed.header.scpValue.closeTime + 5,
+                    upgrades, 0,
+                )
+                upgrades = []
+                lm.close_ledger(
+                    LedgerCloseData(lm.current.header.ledgerSeq, txset, sv)
+                )
+                for a in batch:
+                    created_at[a.get_strkey_public()] = (
+                        lm.last_closed.header.ledgerSeq
+                    )
+            # synthetic bulk rows straight into the accounts table
+            n_synth = max(0, scale - len(srcs))
+            t0 = time.perf_counter()
+            rows = [
+                (
+                    strkey.to_account_strkey(
+                        (0x5A000000 + i).to_bytes(32, "big")
+                    ),
+                    10**9, 1, 0, None, "", thresholds_b64, 0, 1,
+                )
+                for i in range(n_synth)
+            ]
+            with app.database.transaction():
+                app.database.executemany(
+                    """INSERT INTO accounts (accountid, balance, seqnum,
+                       numsubentries, inflationdest, homedomain, thresholds,
+                       flags, lastmodified) VALUES (?,?,?,?,?,?,?,?,?)""",
+                    rows,
+                )
+            populate_s = time.perf_counter() - t0
+            synth_ids = [r[0] for r in rows]
+
+            rng = random.Random(42)
+            cache = entry_cache_of(app.database)
+            times = []
+            cache.hits = cache.misses = 0
+            for j in range(n_ledgers):
+                txs = []
+                for i in range(n_txs):
+                    src = srcs[i]
+                    if synth_ids:
+                        dest_sk = None
+                        dest_id = rng.choice(synth_ids)
+                    else:
+                        dest_id = srcs[i + 1].get_strkey_public()
+                    s = (created_at[src.get_strkey_public()] << 32) + 1 + j
+                    from stellar_tpu.xdr.xtypes import PublicKey
+
+                    dest_pk = PublicKey.from_ed25519(
+                        strkey.from_account_strkey(dest_id)
+                    )
+                    op = T.op(
+                        T.X.OperationType.PAYMENT,
+                        T.X.PaymentOp(
+                            dest_pk, T.X.Asset.native(), 1000
+                        ),
+                    )
+                    txs.append(T.tx_from_ops(app, src, s, [op]))
+                txset = TxSetFrame(lm.last_closed.hash, txs)
+                txset.sort_for_hash()
+                t0 = time.perf_counter()
+                ok = txset.check_valid(app)
+                sv = StellarValue(
+                    txset.get_contents_hash(),
+                    lm.last_closed.header.scpValue.closeTime + 5,
+                    [], 0,
+                )
+                lm.close_ledger(
+                    LedgerCloseData(lm.current.header.ledgerSeq, txset, sv)
+                )
+                times.append(time.perf_counter() - t0)
+                assert ok
+            hit_rate = cache.hits / max(1, cache.hits + cache.misses)
+            p50 = statistics.median(times)
+            results.append((scale, p50, hit_rate, populate_s))
+            print(
+                f"scale {scale:>9,}: p50 {p50 * 1e3:7.0f} ms  "
+                f"cache hit rate {hit_rate * 100:5.1f}%  "
+                f"(populate {populate_s:.1f}s)",
+                flush=True,
+            )
+        finally:
+            app.graceful_stop()
+            clock.shutdown()
+    return results
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "ladder":
+        scales = (
+            tuple(int(s) for s in sys.argv[2:])
+            if len(sys.argv) > 2
+            else (10**4, 10**5, 10**6)
+        )
+        ladder(scales)
+    else:
+        main(
+            int(sys.argv[1]) if len(sys.argv) > 1 else 1000,
+            int(sys.argv[2]) if len(sys.argv) > 2 else 3,
+        )

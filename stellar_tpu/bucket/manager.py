@@ -92,12 +92,14 @@ class BucketManager:
         BucketManagerImpl::checkForMissingBucketsFiles, used by the
         boot-time bucket repair at LedgerManagerImpl.cpp:233-247)."""
         missing = []
+        seen = set()  # ordered result, O(1) dedup (advisor r03)
         for h in has.all_bucket_hashes():
             if (
                 h != ZERO_HASH
-                and h not in missing
+                and h not in seen
                 and not os.path.exists(self.bucket_filename(h))
             ):
+                seen.add(h)
                 missing.append(h)
         return missing
 

@@ -61,6 +61,7 @@ class CachingSigBackend(SigBackend):
 
 
 _pool = None
+_pool_lock = __import__("threading").Lock()
 
 
 def _sodium_verify_loop(items: Sequence[VerifyTriple]) -> List[bool]:
@@ -83,9 +84,11 @@ def _sodium_verify_loop(items: Sequence[VerifyTriple]) -> List[bool]:
     if _pool is None:
         from concurrent.futures import ThreadPoolExecutor
 
-        _pool = ThreadPoolExecutor(
-            max_workers=workers, thread_name_prefix="sodium-verify"
-        )
+        with _pool_lock:  # e.g. prewarm worker + main thread racing init
+            if _pool is None:
+                _pool = ThreadPoolExecutor(
+                    max_workers=workers, thread_name_prefix="sodium-verify"
+                )
     chunk = (n + workers - 1) // workers
 
     def run(lo):

@@ -100,6 +100,29 @@ class TxSetFrame:
             out.extend(batch)
         return out
 
+    def collect_account_ids(self) -> set:
+        """Every account this set can touch: tx sources, op sources, and
+        op targets (create/payment/path destinations, merge target,
+        allow-trust trustor).  Feeds AccountFrame.bulk_warm_cache before
+        apply so big random-access ledgers avoid per-miss point SELECTs."""
+        from ..xdr.txs import OperationType as OT
+
+        ids = set()
+        for tx in self.transactions:
+            ids.add(tx.get_source_id())
+            for op in tx.envelope.tx.operations:
+                if op.sourceAccount is not None:
+                    ids.add(op.sourceAccount)
+                t = op.body.type
+                v = op.body.value
+                if t in (OT.CREATE_ACCOUNT, OT.PAYMENT, OT.PATH_PAYMENT):
+                    ids.add(v.destination)
+                elif t == OT.ACCOUNT_MERGE:
+                    ids.add(v)  # merge body is the destination AccountID
+                elif t == OT.ALLOW_TRUST:
+                    ids.add(v.trustor)
+        return ids
+
     # -- shared validity core ----------------------------------------------
     def _collect_signature_triples(self, app) -> list:
         triples = []

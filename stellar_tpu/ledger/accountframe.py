@@ -224,6 +224,75 @@ class AccountFrame(EntryFrame):
         return frame
 
     @classmethod
+    def bulk_warm_cache(cls, db, account_ids) -> None:
+        """Prime the entry cache for many accounts with chunked IN()
+        selects — one statement per ~500 accounts instead of one point
+        SELECT per cache miss.  Missing accounts cache as known-absent.
+
+        The close path warms every account its txset touches before apply:
+        at 10^6-account scale random payment destinations made every load
+        a point SELECT against a deep B-tree (PROFILE.md round-4 ladder —
+        the 2.6x cliff's dominant term)."""
+        cache = cls.cache_of(db)
+        todo = []
+        for pk in account_ids:
+            if not cache.contains(_ACCT_KEY_PREFIX + pk.value):
+                todo.append(pk)
+        CHUNK = 500
+        for lo in range(0, len(todo), CHUNK):
+            chunk = todo[lo : lo + CHUNK]
+            aids = [_aid(pk) for pk in chunk]
+            ph = ",".join("?" * len(chunk))
+            with db.timed("select", "account-bulk"):
+                rows = db.query_all(
+                    f"""SELECT accountid, balance, seqnum, numsubentries,
+                               inflationdest, homedomain, thresholds, flags,
+                               lastmodified
+                        FROM accounts WHERE accountid IN ({ph})""",
+                    aids,
+                )
+                srows = db.query_all(
+                    f"""SELECT accountid, publickey, weight FROM signers
+                        WHERE accountid IN ({ph})
+                        ORDER BY accountid, publickey""",
+                    aids,
+                )
+            by_aid = {r[0]: r for r in rows}
+            signers_by = {}
+            for aid, spk, w in srows:
+                signers_by.setdefault(aid, []).append(
+                    Signer(_from_aid(spk), w)
+                )
+            for pk, aid in zip(chunk, aids):
+                kb = _ACCT_KEY_PREFIX + pk.value
+                row = by_aid.get(aid)
+                if row is None:
+                    cache.put_owned(kb, None)
+                    continue
+                (_, balance, seqnum, numsub, infl, domain, thresholds,
+                 flags, lastmod) = row
+                ae = AccountEntry(
+                    accountID=pk,
+                    balance=balance,
+                    seqNum=seqnum,
+                    numSubEntries=numsub,
+                    inflationDest=_from_aid(infl) if infl else None,
+                    flags=flags,
+                    homeDomain=domain,
+                    thresholds=base64.b64decode(thresholds),
+                    signers=signers_by.get(aid, []),
+                    ext=0,
+                )
+                cache.put_owned(
+                    kb,
+                    LedgerEntry(
+                        lastmod,
+                        LedgerEntryData(LedgerEntryType.ACCOUNT, ae),
+                        0,
+                    ),
+                )
+
+    @classmethod
     def exists(cls, db, key: LedgerKey) -> bool:
         return (
             db.query_one(

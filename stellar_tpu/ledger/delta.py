@@ -55,7 +55,15 @@ class LedgerDelta:
     # -- header ------------------------------------------------------------
     @property
     def header(self):
-        """Mutable view — private copy made on first access."""
+        """Mutable view — private copy made on first access.
+
+        CONSTRAINT (advisor r03): because the copy is lazy, an OUTER
+        delta's header must not be mutated while a nested delta is live —
+        the nested copy snapshots whatever the outer header holds at the
+        nested delta's FIRST header access, not at construction.  No
+        current call path interleaves outer/nested header mutation (ops
+        mutate only their own innermost delta's header); keep it that way
+        or make the copy eager again."""
         if self._header_local is None:
             self._header_local = _copy_header(self._previous_header)
         return self._header_local

@@ -32,13 +32,17 @@ class EntryCache:
 
     def __init__(self):
         self._map: OrderedDict[bytes, Optional[LedgerEntry]] = OrderedDict()
+        self.hits = 0
+        self.misses = 0
 
     def get(self, key: bytes):
         """(hit, entry-copy-or-None); the caller owns the returned entry."""
         if key in self._map:
             self._map.move_to_end(key)
+            self.hits += 1
             e = self._map[key]
             return True, (xdr_copy(e) if e is not None else None)
+        self.misses += 1
         return False, None
 
     def put(self, key: bytes, entry: Optional[LedgerEntry]):
@@ -51,6 +55,11 @@ class EntryCache:
         self._map.move_to_end(key)
         while len(self._map) > self.CAPACITY:
             self._map.popitem(last=False)
+
+    def contains(self, key: bytes) -> bool:
+        """Membership probe without touching hit/miss counters or LRU
+        order (used by bulk prewarm to split warm/cold)."""
+        return key in self._map
 
     def erase(self, key: bytes):
         self._map.pop(key, None)

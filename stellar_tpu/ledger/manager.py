@@ -173,10 +173,19 @@ class LedgerManager:
             state_json, lambda ok: result.update(ok=ok)
         )
         # boot is synchronous: crank the (not-yet-running) clock until the
-        # repair's subprocess pipeline completes
-        self.app.clock.crank_until(lambda: "ok" in result, timeout=300.0)
+        # repair's subprocess pipeline completes.  The cap scales with how
+        # much there is to fetch — a slow-but-progressing archive download
+        # must not abort boot just because many buckets are missing (the
+        # reference runs downloadMissingBuckets with per-file retries and
+        # no global cap; advisor r03).
+        timeout = max(300.0, 120.0 * len(missing))
+        self.app.clock.crank_until(lambda: "ok" in result, timeout=timeout)
         if not result.get("ok"):
-            raise RuntimeError("bucket repair from history archives failed")
+            raise RuntimeError(
+                f"bucket repair from history archives failed or timed out "
+                f"after {timeout:.0f}s ({len(missing)} bucket(s) requested, "
+                f"completion {'reported failure' if 'ok' in result else 'never reported'})"
+            )
 
     # -- externalize path (LedgerManagerImpl.cpp:321-408) ------------------
     def externalize_value(self, ledger_data) -> None:
@@ -284,6 +293,14 @@ class LedgerManager:
             ledger_delta = LedgerDelta(self.current.header, self.database)
 
             txs = ledger_data.tx_set.sort_for_apply()
+            # bulk-load every account the set touches into the entry cache
+            # (chunked IN() selects) BEFORE the signature prewarm collects
+            # its triples — both it and apply then run on a warm cache
+            from .accountframe import AccountFrame
+
+            AccountFrame.bulk_warm_cache(
+                self.database, ledger_data.tx_set.collect_account_ids()
+            )
             # pre-warm the verify cache for the whole set in one batch,
             # overlapped with fee processing (signature checks only start
             # at apply, after the join) — at apply time every check hits

@@ -45,7 +45,6 @@ class LoopbackPeer(Peer):
     def send_frame(self, data: bytes) -> None:
         if self._closed or self.remote is None:
             return
-        self.wrote_bytes()  # loopback "wire" = the remote's queue
         self.out_queue.append(data)
         while len(self.out_queue) > self.max_queue_depth:
             self.out_queue.popleft()  # shed oldest (queue-bounded transport)
@@ -67,6 +66,11 @@ class LoopbackPeer(Peer):
         """Move one queued frame into the remote peer, applying faults."""
         if self.remote is None or not self.out_queue:
             return False
+        # like TCPPeer (which stamps on kernel-accepted bytes), write
+        # progress is stamped when a frame actually moves on the "wire" —
+        # a peer whose output only ever piles into a shedding queue makes
+        # no progress and must trip the idle write timeout (advisor r03)
+        self.wrote_bytes()
         entry = self.out_queue.popleft()
         # entries re-queued by a fault are marked stale so the duplicate /
         # reorder faults can't recurse and delivery always terminates

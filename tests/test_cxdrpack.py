@@ -64,9 +64,17 @@ def test_catalog_is_meaningful():
     assert len(TYPES) > 40
 
 
+def _seed(cls) -> int:
+    """Stable across processes (hash() is PYTHONHASHSEED-randomized —
+    a failing fuzz case must reproduce)."""
+    import zlib
+
+    return zlib.crc32(cls.__name__.encode())
+
+
 @pytest.mark.parametrize("cls", TYPES, ids=lambda c: c.__name__)
 def test_c_pack_matches_python_pack(cls):
-    rng = random.Random(hash(cls.__name__) & 0xFFFF)
+    rng = random.Random(_seed(cls))
     codec = codec_of(cls)
     for i in range(25):
         val = arbitrary.arbitrary(codec, size=8, rng=rng)
@@ -92,7 +100,7 @@ def test_c_copy_matches_python_copy(cls):
     values are truly independent of the original."""
     from stellar_tpu.xdr.base import xdr_copy
 
-    rng = random.Random(hash(cls.__name__) & 0xFFF)
+    rng = random.Random(_seed(cls) ^ 1)
     codec = codec_of(cls)
     for _ in range(10):
         val = arbitrary.arbitrary(codec, size=8, rng=rng)

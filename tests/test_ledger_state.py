@@ -94,6 +94,41 @@ class TestAccountFrame:
         assert AccountFrame.load_account(mk_account(9), db) is None
         assert AccountFrame.load_account(mk_account(9), db) is None
 
+    def test_bulk_warm_cache_matches_point_loads(self, db, header):
+        """AccountFrame.bulk_warm_cache (the big-ledger close prewarm)
+        must cache entries identical to load_account's — including
+        signers, inflationDest, and known-absent accounts."""
+        delta = LedgerDelta(header, db)
+        ids = []
+        for i in range(1, 8):
+            aid = mk_account(i)
+            af = AccountFrame(account_id=aid)
+            af.set_balance(10**7 * i)
+            af.set_seq_num(i << 32)
+            if i % 2:
+                af.account.signers = [X.Signer(mk_account(20 + i), i)]
+            if i % 3 == 0:
+                af.account.inflationDest = mk_account(30 + i)
+            af.store_add(delta, db)
+            ids.append(aid)
+        ghost = mk_account(99)
+        # point-load ground truth with a cold cache
+        AccountFrame.cache_of(db).clear()
+        truth = {}
+        for aid in ids:
+            truth[aid.value] = AccountFrame.load_account(aid, db).entry
+        # bulk path, cold cache again
+        cache = AccountFrame.cache_of(db)
+        cache.clear()
+        cache.hits = cache.misses = 0
+        AccountFrame.bulk_warm_cache(db, ids + [ghost])
+        for aid in ids:
+            back = AccountFrame.load_account(aid, db)
+            assert back.entry == truth[aid.value]
+        assert AccountFrame.load_account(ghost, db) is None
+        # every post-warm load was a cache hit: no point SELECTs ran
+        assert cache.misses == 0 and cache.hits == len(ids) + 1
+
     def test_thresholds_defaults(self, db):
         af = AccountFrame(account_id=mk_account(1))
         assert af.get_master_weight() == 1
