@@ -201,11 +201,14 @@ class AccountFrame(EntryFrame):
         signers = [
             Signer(_from_aid(pk), w)
             for pk, w in db.query_all(
-                "SELECT publickey, weight FROM signers WHERE accountid=?"
-                " ORDER BY publickey",
+                "SELECT publickey, weight FROM signers WHERE accountid=?",
                 (aid,),
             )
         ]
+        # canonical order is RAW pubKey bytes (AccountFrame.cpp:299
+        # re-sorts after fetch; ORDER BY on the strkey TEXT differs —
+        # base32's '2'..'7' sort before 'A' in ASCII)
+        signers.sort(key=lambda s: s.pubKey.value)
         ae = AccountEntry(
             accountID=account_id,
             balance=balance,
@@ -253,8 +256,7 @@ class AccountFrame(EntryFrame):
                 )
                 srows = db.query_all(
                     f"""SELECT accountid, publickey, weight FROM signers
-                        WHERE accountid IN ({ph})
-                        ORDER BY accountid, publickey""",
+                        WHERE accountid IN ({ph})""",
                     aids,
                 )
             by_aid = {r[0]: r for r in rows}
@@ -263,6 +265,9 @@ class AccountFrame(EntryFrame):
                 signers_by.setdefault(aid, []).append(
                     Signer(_from_aid(spk), w)
                 )
+            for lst in signers_by.values():
+                # raw-byte canonical order, like load_account
+                lst.sort(key=lambda s: s.pubKey.value)
             for pk, aid in zip(chunk, aids):
                 kb = _ACCT_KEY_PREFIX + pk.value
                 row = by_aid.get(aid)
@@ -301,6 +306,24 @@ class AccountFrame(EntryFrame):
             )
             is not None
         )
+
+    def _normalize(self) -> None:
+        """Canonical signer order is RAW pubKey bytes
+        (AccountFrame::normalize / signerCompare) — enforced at the WRITE
+        path so the cached snapshot, the delta entry, the SQL rows, and
+        every hash preimage agree regardless of where the entry came from
+        (SetOptions mutation, bucket apply during catchup, tests)."""
+        s = self.account.signers
+        if len(s) > 1:
+            s.sort(key=lambda sg: sg.pubKey.value)
+
+    def store_add(self, delta, db) -> None:
+        self._normalize()
+        super().store_add(delta, db)
+
+    def store_change(self, delta, db) -> None:
+        self._normalize()
+        super().store_change(delta, db)
 
     def _persist(self, db, insert: bool) -> None:
         a = self.account

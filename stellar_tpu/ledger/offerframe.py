@@ -220,11 +220,22 @@ class OfferFrame(EntryFrame):
                     ),
                 )
         else:
+            # every mutable column, assets included — ManageOffer update may
+            # swap selling/buying (OfferFrame.cpp:508-512 does the same)
             with db.timed("update", "offer"):
                 db.execute(
-                    """UPDATE offers SET amount=?, pricen=?, priced=?, price=?,
-                       flags=?, lastmodified=? WHERE offerid=?""",
+                    """UPDATE offers SET sellingassettype=?,
+                       sellingassetcode=?, sellingissuer=?, buyingassettype=?,
+                       buyingassetcode=?, buyingissuer=?, amount=?, pricen=?,
+                       priced=?, price=?, flags=?, lastmodified=?
+                       WHERE offerid=?""",
                     (
+                        satype,
+                        sacode,
+                        saissuer,
+                        batype,
+                        bacode,
+                        baissuer,
                         o.amount,
                         o.price.n,
                         o.price.d,

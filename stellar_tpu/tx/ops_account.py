@@ -243,6 +243,8 @@ class SetOptionsOpFrame(OperationFrame):
                     else:
                         kept.append(old)
                 account.signers = kept
+            # canonical raw-pubKey ordering is enforced by
+            # AccountFrame._normalize at the store below
 
         metrics.new_meter(("op-set-options", "success", "apply"), "operation").mark()
         self.set_inner_result(SetOptionsResult(SetOptionsResultCode.SET_OPTIONS_SUCCESS))
