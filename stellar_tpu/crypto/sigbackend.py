@@ -22,6 +22,13 @@ from .sigcache import VerifySigCache
 
 VerifyTriple = Tuple[bytes, bytes, bytes]  # (pubkey32, msg, sig64)
 
+# Default device/host breakeven for the tpu backend, in cache-miss verifies:
+# n/host_rate = rtt + n/device_rate at the MEASURED relay (68 ms RTT, 230k/s
+# device, 16k/s host core) gives n ≈ 1,100.  Locally-attached TPU (sub-ms
+# dispatch) breaks even near ~20 — retune HERE (Config.TPU_CPU_CUTOVER
+# references this constant).
+DEFAULT_TPU_CPU_CUTOVER = 1024
+
 
 class SigBackend:
     name = "abstract"
@@ -116,14 +123,19 @@ class TpuSigBackend(SigBackend):
 
     name = "tpu"
 
-    def __init__(self, max_batch: int = 4096, mesh=None, cpu_cutover: int = 256):
+    def __init__(
+        self,
+        max_batch: int = 4096,
+        mesh=None,
+        cpu_cutover: int = DEFAULT_TPU_CPU_CUTOVER,
+    ):
         from ..ops.ed25519 import BatchVerifier  # lazy: JAX import
 
         self._verifier = BatchVerifier(max_batch=max_batch, mesh=mesh)
         # Below this many cache misses a device round-trip costs more than
-        # looping libsodium on host (one relay RTT ≈ 68 ms ≈ 1,100 CPU
-        # verifies) — lone SCP envelopes and small tx sets must never pay
-        # device latency just because the backend is "tpu".
+        # looping libsodium on host — lone SCP envelopes and small tx sets
+        # must never pay device latency just because the backend is "tpu"
+        # (see DEFAULT_TPU_CPU_CUTOVER for the breakeven arithmetic).
         self.cpu_cutover = cpu_cutover
         self.n_cutover_items = 0
 

@@ -72,8 +72,11 @@ class Config:
         self.SIG_BATCH_MAX = 4096
         # below this many cache-miss verifies the tpu backend loops
         # libsodium instead of paying a device round-trip (tests set 0 to
-        # force every batch onto the device path)
-        self.TPU_CPU_CUTOVER = 256
+        # force every batch onto the device path; breakeven arithmetic at
+        # the constant's definition)
+        from ..crypto.sigbackend import DEFAULT_TPU_CPU_CUTOVER
+
+        self.TPU_CPU_CUTOVER = DEFAULT_TPU_CPU_CUTOVER
 
     # -- loading -----------------------------------------------------------
     @classmethod

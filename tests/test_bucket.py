@@ -378,3 +378,94 @@ def test_duplicate_entries_in_one_batch(app):
     entries = list(b)
     assert len(entries) == 1
     assert entries[0].value.data.value.balance == 777
+
+
+def test_tombstones_expire_at_bottom_level(app):
+    """BucketTests.cpp:339-398: dead entries (tombstones) survive merges at
+    every level EXCEPT the bottom, whose merges drop them (keep_dead=False
+    at NUM_LEVELS-1) — nothing sits below the bottom to annihilate."""
+    import random
+
+    from stellar_tpu.ledger.entryframe import ledger_key_of
+
+    rng = random.Random(31)
+
+    def dead_keys(n, tag):
+        return [
+            ledger_key_of(account_entry(rng.randrange(1 << 30), 1))
+            for _ in range(n)
+        ]
+
+    bm = app.bucket_manager
+    bl = BucketList()
+    # seed every level with random live+dead content
+    uid = 10**6
+    for i in range(NUM_LEVELS):
+        lev = bl.get_level(i)
+        lev.curr = Bucket.fresh(
+            bm, [account_entry(uid + j) for j in range(8)], dead_keys(8, i)
+        )
+        uid += 8
+        lev.snap = Bucket.fresh(
+            bm, [account_entry(uid + j) for j in range(8)], dead_keys(8, i)
+        )
+        uid += 8
+    # provoke merges at each level's half/size boundaries
+    for i in range(NUM_LEVELS):
+        for j in (level_half(i), level_size(i)):
+            bl.add_batch(
+                app, j, [account_entry(uid + k) for k in range(8)],
+                dead_keys(8, f"b{j}"),
+            )
+            uid += 8
+            for k in range(NUM_LEVELS):
+                nxt = bl.get_level(k).next
+                if nxt.is_live():
+                    nxt.resolve()  # force the merge; commit() installs it
+
+    def count_dead(bucket):
+        return sum(
+            1 for e in bucket if e.type == BucketEntryType.DEADENTRY
+        )
+
+    assert count_dead(bl.get_level(NUM_LEVELS - 3).curr) != 0
+    assert count_dead(bl.get_level(NUM_LEVELS - 2).curr) != 0
+    assert count_dead(bl.get_level(NUM_LEVELS - 1).curr) == 0
+
+
+def test_single_entry_bubbling_up(app):
+    """BucketTests.cpp:651-726: one entry added at ledger 1 then 300 empty
+    batches — at every ledger the entry lives in exactly the level whose
+    [lowBoundExclusive, highBoundInclusive] window covers ledger 1, and
+    exactly once."""
+
+    def mask(v, m):
+        return v & ~(m - 1)
+
+    def low_bound_exclusive(level, ledger):
+        return mask(ledger, level_size(level))
+
+    def high_bound_inclusive(level, ledger):
+        if level == 0:
+            return ledger  # prev(0) undefined; level 0 holds the newest
+        return mask(ledger, level_size(level - 1))
+
+    bl = BucketList()
+    entry = account_entry(424242)
+    bl.add_batch(app, 1, [entry], [])
+    for i in range(2, 300):
+        bl.add_batch(app, i, [], [])
+        for k in range(NUM_LEVELS):
+            nxt = bl.get_level(k).next
+            if nxt.is_live():
+                nxt.resolve()  # force the merge; commit() installs it
+        for j in range(NUM_LEVELS):
+            lev = bl.get_level(j)
+            curr_sz = sum(1 for _ in lev.curr)
+            snap_sz = sum(1 for _ in lev.snap)
+            lb = low_bound_exclusive(j, i)
+            hb = high_bound_inclusive(j, i)
+            if lb < 1 <= hb:
+                assert curr_sz + snap_sz == 1, (i, j)
+            else:
+                assert curr_sz == 0 and snap_sz == 0, (i, j)
