@@ -231,8 +231,22 @@ class MetricsRegistry:
     def __init__(self, clock=None):
         self._clock = clock
         self._metrics: Dict[str, object] = {}
+        # tuple-parts -> metric: the hot apply path looks the same meters/
+        # timers up ~8x per tx; this skips the join + isinstance + factory
+        # allocation on every hit (0.6 s tottime per 10^6-scale close)
+        self._by_parts: Dict[tuple, object] = {}
 
-    def _get(self, name, factory, want_type):
+    def _get(self, parts, factory, want_type):
+        # fast path: (tuple-parts, type) memo hit — no join, no isinstance
+        # chain, no factory allocation.  Keying on the type keeps _get's
+        # collision guard intact for memo hits too.
+        memo_key = None
+        if isinstance(parts, tuple):
+            memo_key = (parts, want_type)
+            m = self._by_parts.get(memo_key)
+            if m is not None:
+                return m
+        name = self._name(parts)
         m = self._metrics.get(name)
         if m is None:
             m = factory()
@@ -242,6 +256,8 @@ class MetricsRegistry:
             raise TypeError(
                 f"metric {name!r} is {type(m).__name__}, not {want_type.__name__}"
             )
+        if memo_key is not None:
+            self._by_parts[memo_key] = m
         return m
 
     @staticmethod
@@ -249,18 +265,16 @@ class MetricsRegistry:
         return ".".join(parts) if not isinstance(parts, str) else parts
 
     def new_counter(self, parts) -> Counter:
-        return self._get(self._name(parts), Counter, Counter)
+        return self._get(parts, Counter, Counter)
 
     def new_meter(self, parts, event_type: str = "event") -> Meter:
-        return self._get(
-            self._name(parts), lambda: Meter(event_type, self._clock), Meter
-        )
+        return self._get(parts, lambda: Meter(event_type, self._clock), Meter)
 
     def new_histogram(self, parts) -> Histogram:
-        return self._get(self._name(parts), Histogram, Histogram)
+        return self._get(parts, Histogram, Histogram)
 
     def new_timer(self, parts) -> Timer:
-        return self._get(self._name(parts), lambda: Timer(self._clock), Timer)
+        return self._get(parts, lambda: Timer(self._clock), Timer)
 
     def get(self, parts):
         return self._metrics.get(self._name(parts))

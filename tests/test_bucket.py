@@ -390,7 +390,7 @@ def test_tombstones_expire_at_bottom_level(app):
 
     rng = random.Random(31)
 
-    def dead_keys(n, tag):
+    def dead_keys(n):
         return [
             ledger_key_of(account_entry(rng.randrange(1 << 30), 1))
             for _ in range(n)
@@ -403,11 +403,11 @@ def test_tombstones_expire_at_bottom_level(app):
     for i in range(NUM_LEVELS):
         lev = bl.get_level(i)
         lev.curr = Bucket.fresh(
-            bm, [account_entry(uid + j) for j in range(8)], dead_keys(8, i)
+            bm, [account_entry(uid + j) for j in range(8)], dead_keys(8)
         )
         uid += 8
         lev.snap = Bucket.fresh(
-            bm, [account_entry(uid + j) for j in range(8)], dead_keys(8, i)
+            bm, [account_entry(uid + j) for j in range(8)], dead_keys(8)
         )
         uid += 8
     # provoke merges at each level's half/size boundaries
@@ -415,7 +415,7 @@ def test_tombstones_expire_at_bottom_level(app):
         for j in (level_half(i), level_size(i)):
             bl.add_batch(
                 app, j, [account_entry(uid + k) for k in range(8)],
-                dead_keys(8, f"b{j}"),
+                dead_keys(8),
             )
             uid += 8
             for k in range(NUM_LEVELS):

@@ -858,6 +858,51 @@ class TestBallotProtocolPorted4:
         assert pl.prepare.ballot == bz and pl.prepare.prepared == bz
         assert pl.prepare.preparedPrime == by
 
+    def test_timeout_then_old_messages_still_advance_prepared(self):
+        """SCPTests.cpp:1420-1465 'timeout after prepare, receive old
+        messages to prepare': after two local timeouts to (3,x), old
+        (2,x)-era messages from peers must still raise prepared and nP —
+        stale-but-valid evidence is not discarded."""
+        n = Core5()
+        x1, x2, x3 = SCPBallot(1, X), SCPBallot(2, X), SCPBallot(3, X)
+        assert n.scp.get_slot(1).bump_state(X, force=True)
+        assert len(n.emitted) == 1
+        assert n.last_emit().prepare.ballot == x1
+
+        n.recv_quorum(lambda: prepare_st(n.qs_hash, x1))
+        # quorum -> prepared (1,x)
+        assert len(n.emitted) == 2
+        pl = n.last_emit()
+        assert pl.prepare.ballot == x1 and pl.prepare.prepared == x1
+
+        # two local timeouts: prepares (2,x) then (3,x), prepared stays x1
+        assert n.scp.get_slot(1).bump_state(X, force=True)
+        assert len(n.emitted) == 3
+        pl = n.last_emit()
+        assert pl.prepare.ballot == x2 and pl.prepare.prepared == x1
+        assert n.scp.get_slot(1).bump_state(X, force=True)
+        assert len(n.emitted) == 4
+        pl = n.last_emit()
+        assert pl.prepare.ballot == x3 and pl.prepare.prepared == x1
+
+        # other nodes moved on with x2: v-blocking -> prepared x2
+        n.recv_vblocking(
+            lambda: prepare_st(n.qs_hash, x2, prepared=x2, nC=1, nP=2)
+        )
+        assert len(n.emitted) == 5
+        pl = n.last_emit()
+        assert pl.prepare.ballot == x3 and pl.prepare.prepared == x2
+
+        # quorum on x2 -> nP=2 (nC stays 0: h.value != b.value rule n/a;
+        # the reference expects nC=0, nP=2)
+        assert n.recv(
+            3, prepare_st(n.qs_hash, x2, prepared=x2, nC=1, nP=2)
+        ) == EnvelopeState.VALID
+        assert len(n.emitted) == 6
+        pl = n.last_emit()
+        assert pl.prepare.ballot == x3 and pl.prepare.prepared == x2
+        assert pl.prepare.nC == 0 and pl.prepare.nP == 2
+
     def test_timeout_with_p_set_stays_locked_on_value(self):
         """:1328-1356: once P (confirmed prepared) is set on x, a timeout
         bump to y must stay locked on x — only the counter moves."""
