@@ -15,10 +15,15 @@ share memoization exactly like the reference's gVerifySigCache.
 
 from __future__ import annotations
 
-from typing import Iterable, List, Sequence, Tuple
+import threading
+import time
+from typing import Any, Iterable, List, Sequence, Tuple
 
+from ..util import xlog
 from . import sodium
 from .sigcache import VerifySigCache
+
+_log = xlog.logger("Tx")
 
 VerifyTriple = Tuple[bytes, bytes, bytes]  # (pubkey32, msg, sig64)
 
@@ -68,7 +73,7 @@ class CachingSigBackend(SigBackend):
 
 
 _pool = None
-_pool_lock = __import__("threading").Lock()
+_pool_lock = threading.Lock()
 
 
 def _sodium_verify_loop(items: Sequence[VerifyTriple]) -> List[bool]:
@@ -138,16 +143,62 @@ class TpuSigBackend(SigBackend):
         # (see DEFAULT_TPU_CPU_CUTOVER for the breakeven arithmetic).
         self.cpu_cutover = cpu_cutover
         self.n_cutover_items = 0
+        self.n_wedge_fallback_items = 0
+        self._wedged_until = 0.0
+
+    # A wedged device dispatch (e.g. accelerator transport outage) must
+    # never stall a caller indefinitely — SCP envelope flushes run on the
+    # main crank and ledger close joins the prewarm; the reference's
+    # inline libsodium path cannot hang, so neither may this one.  After
+    # DEVICE_TIMEOUT the batch finishes on host and the backend LATCHES
+    # onto host for RETRY_INTERVAL (a persistently-dead transport costs
+    # at most one bounded stall per interval, not one per batch).
+    DEVICE_TIMEOUT = 15.0
+    RETRY_INTERVAL = 60.0
 
     def verify_batch(self, items: Sequence[VerifyTriple]) -> List[bool]:
         if len(items) < self.cpu_cutover:
             self.n_cutover_items += len(items)
             return _sodium_verify_loop(items)
-        return self._verifier.verify(items)
+        now = time.monotonic()
+        if now < self._wedged_until:
+            self.n_wedge_fallback_items += len(items)
+            return _sodium_verify_loop(items)
+        result: List[Any] = [None]
+        err: List[BaseException] = []
+        done = threading.Event()
+
+        def work():
+            try:
+                result[0] = self._verifier.verify(items)
+            except BaseException as e:
+                err.append(e)
+            finally:
+                done.set()
+
+        t = threading.Thread(target=work, name="tpu-verify", daemon=True)
+        t.start()
+        if not done.wait(self.DEVICE_TIMEOUT):
+            self._wedged_until = now + self.RETRY_INTERVAL
+            self.n_wedge_fallback_items += len(items)
+            _log.warning(
+                "device verify batch stalled >%.0fs; finishing %d verifies"
+                " on host and latching onto host for %.0fs",
+                self.DEVICE_TIMEOUT,
+                len(items),
+                self.RETRY_INTERVAL,
+            )
+            # the orphaned worker's eventual completion is harmless: the
+            # caller-side cache scatter-back writes identical values
+            return _sodium_verify_loop(items)
+        if err:
+            raise err[0]
+        return result[0]
 
     def stats(self) -> dict:
         s = self._verifier.stats()
         s["cpu_cutover_items"] = self.n_cutover_items
+        s["wedge_fallback_items"] = self.n_wedge_fallback_items
         return s
 
 

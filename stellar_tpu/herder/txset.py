@@ -169,6 +169,9 @@ class TxSetFrame:
         t = threading.Thread(target=work, name="sig-prewarm", daemon=True)
         t.start()
 
+        # join() is bounded even through a wedged accelerator transport:
+        # TpuSigBackend.verify_batch carries its own DEVICE_TIMEOUT + host
+        # fallback (covering every call site, not just this one)
         def join():
             t.join()
             if err:

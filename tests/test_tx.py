@@ -595,6 +595,51 @@ def test_cpu_and_tpu_backends_close_identical_ledgers():
     assert hashes[0] == hashes[1]
 
 
+def test_wedged_device_dispatch_falls_back_to_host_and_latches():
+    """A wedged accelerator dispatch (hung transport) must never stall a
+    verify_batch caller — SCP flushes run on the main crank and ledger
+    close joins the prewarm.  The backend finishes on host within
+    DEVICE_TIMEOUT, then LATCHES onto host so a persistent outage costs
+    one bounded stall per RETRY_INTERVAL, not one per batch."""
+    import threading
+    import time as _time
+
+    from stellar_tpu.crypto.sigbackend import TpuSigBackend
+
+    be = TpuSigBackend.__new__(TpuSigBackend)  # skip JAX verifier init
+    be.cpu_cutover = 0
+    be.n_cutover_items = 0
+    be.n_wedge_fallback_items = 0
+    be._wedged_until = 0.0
+    be.DEVICE_TIMEOUT = 0.2
+
+    class WedgedVerifier:
+        calls = 0
+
+        def verify(self, items):
+            WedgedVerifier.calls += 1
+            threading.Event().wait()  # wedged forever
+
+    be._verifier = WedgedVerifier()
+    sk = SecretKey.pseudo_random_for_testing(3)
+    msg = b"wedge"
+    items = [(sk.public_raw, msg, sk.sign(msg))]
+    t0 = _time.perf_counter()
+    assert be.verify_batch(items) == [True]  # host fallback, correct result
+    assert 0.2 <= _time.perf_counter() - t0 < 5
+    assert WedgedVerifier.calls == 1
+    # latched: the next batch goes straight to host, no new device attempt
+    t0 = _time.perf_counter()
+    assert be.verify_batch(items) == [True]
+    assert _time.perf_counter() - t0 < 0.1
+    assert WedgedVerifier.calls == 1
+    assert be.n_wedge_fallback_items == 2
+    # after the latch expires the device is probed again (and re-latches)
+    be._wedged_until = 0.0
+    assert be.verify_batch(items) == [True]
+    assert WedgedVerifier.calls == 2
+
+
 def test_start_rejects_insane_quorum_set(clock):
     """A validator whose configured QUORUM_SET omits itself must fail fast
     at start (reference: ApplicationImpl.cpp:230-240)."""
