@@ -104,21 +104,27 @@ def _platform_forced_cpu() -> bool:
     return os.environ.get("JAX_PLATFORMS", "") == "cpu"
 
 
+# Child processes honor JAX_PLATFORMS via an in-process config update: the
+# environment's sitecustomize registers/latches its own platform before env
+# vars are consulted, so env alone cannot redirect a child (the production
+# env sets JAX_PLATFORMS=axon — children target the relay by default).
+_CHILD_PLATFORM_PREAMBLE = (
+    "import os\n"
+    "p = os.environ.get('JAX_PLATFORMS')\n"
+    "if p:\n"
+    "    import jax\n"
+    "    jax.config.update('jax_platforms', p)\n"
+)
+
+
 def _probe_tpu_alive(timeout=90.0) -> bool:
     """True iff a fresh child process can init the JAX backend and see a
     device.  A dead axon relay makes backend init block FOREVER in-process
     (observed r03: 4+ hour outage, watchdog fired at stage 'tpu-init' and
     the round recorded 0.0) — so the probe runs in a killable subprocess,
     never in the benchmark process itself."""
-    # the child honors JAX_PLATFORMS via an in-process config update: the
-    # environment's sitecustomize registers/latches its own platform before
-    # env vars are consulted, so env alone cannot redirect the child (the
-    # production env sets JAX_PLATFORMS=axon — the probe targets the relay)
     code = (
-        "import os, jax\n"
-        "p = os.environ.get('JAX_PLATFORMS')\n"
-        "if p:\n"
-        "    jax.config.update('jax_platforms', p)\n"
+        _CHILD_PLATFORM_PREAMBLE + "import jax\n"
         "assert jax.devices()\n"
         "print('ok')\n"
     )
@@ -349,7 +355,7 @@ def _close_in_subprocess(n_txs: int, n_ledgers: int, timeout: float) -> dict:
         else ""
     )
     code = (
-        hang + "import json, bench\n"
+        hang + _CHILD_PLATFORM_PREAMBLE + "import json, bench\n"
         f"r = bench.bench_ledger_close(n_txs={n_txs}, n_ledgers={n_ledgers})\n"
         "print('CLOSE_RESULT ' + json.dumps(r), flush=True)\n"
     )

@@ -150,18 +150,22 @@ class TpuSigBackend(SigBackend):
     # never stall a caller indefinitely — SCP envelope flushes run on the
     # main crank and ledger close joins the prewarm; the reference's
     # inline libsodium path cannot hang, so neither may this one.  After
-    # DEVICE_TIMEOUT the batch finishes on host and the backend LATCHES
-    # onto host for RETRY_INTERVAL (a persistently-dead transport costs
-    # at most one bounded stall per interval, not one per batch).
+    # the timeout the batch finishes on host and the backend LATCHES onto
+    # host for RETRY_INTERVAL (a persistently-dead transport costs at
+    # most one bounded stall per interval, not one per batch).  The FIRST
+    # dispatch gets a much longer budget: per-bucket XLA/remote compiles
+    # legitimately take tens of seconds and must not false-latch a
+    # healthy device (a false latch would self-heal after RETRY_INTERVAL,
+    # but costs double work and misleading wedge telemetry).
     DEVICE_TIMEOUT = 15.0
+    DEVICE_FIRST_TIMEOUT = 90.0
     RETRY_INTERVAL = 60.0
 
     def verify_batch(self, items: Sequence[VerifyTriple]) -> List[bool]:
         if len(items) < self.cpu_cutover:
             self.n_cutover_items += len(items)
             return _sodium_verify_loop(items)
-        now = time.monotonic()
-        if now < self._wedged_until:
+        if time.monotonic() < self._wedged_until:
             self.n_wedge_fallback_items += len(items)
             return _sodium_verify_loop(items)
         result: List[Any] = [None]
@@ -178,13 +182,18 @@ class TpuSigBackend(SigBackend):
 
         t = threading.Thread(target=work, name="tpu-verify", daemon=True)
         t.start()
-        if not done.wait(self.DEVICE_TIMEOUT):
-            self._wedged_until = now + self.RETRY_INTERVAL
+        timeout = (
+            self.DEVICE_FIRST_TIMEOUT
+            if self._verifier.n_device_calls == 0
+            else self.DEVICE_TIMEOUT
+        )
+        if not done.wait(timeout):
+            self._wedged_until = time.monotonic() + self.RETRY_INTERVAL
             self.n_wedge_fallback_items += len(items)
             _log.warning(
                 "device verify batch stalled >%.0fs; finishing %d verifies"
                 " on host and latching onto host for %.0fs",
-                self.DEVICE_TIMEOUT,
+                timeout,
                 len(items),
                 self.RETRY_INTERVAL,
             )

@@ -100,6 +100,33 @@ def test_bench_close_stage_hang_is_killed_not_fatal():
     assert "watchdog" not in out
 
 
+def test_bench_close_subprocess_success_path():
+    """The killable close-stage child's CLOSE_RESULT line must parse back
+    into the parent's JSON (not just the kill path)."""
+    r = run_bench(
+        {
+            "BENCH_BATCH": "128",
+            "BENCH_CHUNKS": "1",
+            "BENCH_ITERS": "1",
+            "BENCH_GOOD_RATE": "1",
+            "BENCH_CLOSE_SUBPROC": "1",
+            "BENCH_CLOSE_TXS": "50",
+            "BENCH_CLOSE_LEDGERS": "2",
+            "BENCH_CLOSE_TIMEOUT": "180",
+            # the child re-runs under the ambient platform; force CPU there
+            "JAX_PLATFORMS": "cpu",
+        }
+    )
+    assert r.returncode == 0, (r.stdout, r.stderr[-500:])
+    lines = [l for l in r.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, r.stdout
+    out = json.loads(lines[0])
+    assert out["value"] > 0
+    assert out["ledger_close_txs"] == 50
+    assert out["ledger_close_p50_ms"] > 0
+    assert "ledger_close_error" not in out
+
+
 def test_probe_tpu_alive_success_path(monkeypatch):
     """The killable-subprocess probe must report True on a healthy backend
     (here: the child inherits JAX_PLATFORMS=cpu and sees CPU devices)."""

@@ -615,6 +615,7 @@ def test_wedged_device_dispatch_falls_back_to_host_and_latches():
 
     class WedgedVerifier:
         calls = 0
+        n_device_calls = 1  # past warm-up: the short DEVICE_TIMEOUT applies
 
         def verify(self, items):
             WedgedVerifier.calls += 1
