@@ -595,6 +595,66 @@ def test_cpu_and_tpu_backends_close_identical_ledgers():
     assert hashes[0] == hashes[1]
 
 
+def test_paranoid_mode_audits_every_close(clock):
+    """PARANOID_MODE (LedgerDelta.check_against_database, the reference's
+    --paranoid ledger audit at LedgerManagerImpl.cpp:705): mixed-op closes
+    pass the delta-vs-DB comparison; a row corrupted behind the delta's
+    back makes the close raise instead of committing divergent state."""
+    cfg = T.get_test_config(84)
+    cfg.PARANOID_MODE = True
+    app = Application(clock, cfg, new_db=True)
+    try:
+        root = T.root_key_for(app)
+        lm = app.ledger_manager
+        a = fund(app, root, T.get_account(1), amount=10**11)
+        b = fund(app, root, T.get_account(2), amount=10**11)
+        # audited close with a payment + a trustline + an offer, so every
+        # entry-type arm of check_against_database runs
+        usd = X.Asset.alphanum4(b"USD", a.get_public_key())
+        txs = [
+            T.tx_from_ops(app, a, (2 << 32) + 1, [T.payment_op(b, 10**6)]),
+            T.tx_from_ops(app, b, (2 << 32) + 1,
+                          [T.change_trust_op(usd, 10**10)]),
+            T.tx_from_ops(app, a, (2 << 32) + 2, [T.manage_offer_op(
+                X.Asset.native(), usd, 10**6, X.Price(1, 1))]),
+        ]
+        seq_before = lm.last_closed.header.ledgerSeq
+        T.close_ledger_on(
+            app, lm.last_closed.header.scpValue.closeTime + 5, txs
+        )
+        assert lm.last_closed.header.ledgerSeq == seq_before + 1
+
+        # negative: the audit exists to catch a delta/SQL divergence bug —
+        # simulate a "missed SQL write" (the delta and cache record the
+        # new entry, the row never lands) and the close must raise instead
+        # of committing divergent state
+        from stellar_tpu.ledger.accountframe import AccountFrame
+
+        orig_persist = AccountFrame._persist
+        dropped = []
+        target = a.get_public_key()  # the payment DEST: its only write
+
+        def flaky_persist(self, db, insert):
+            if self.get_id() == target and not dropped:
+                dropped.append(self.get_id())
+                return  # lose exactly one SQL write (no later write masks it)
+            orig_persist(self, db, insert)
+
+        AccountFrame._persist = flaky_persist
+        try:
+            bad = [T.tx_from_ops(app, b, (2 << 32) + 2,
+                                 [T.payment_op(a, 10**6)])]
+            with pytest.raises(RuntimeError, match="delta-vs-database"):
+                T.close_ledger_on(
+                    app, lm.last_closed.header.scpValue.closeTime + 5, bad
+                )
+        finally:
+            AccountFrame._persist = orig_persist
+        assert dropped, "the fault was never injected"
+    finally:
+        app.database.close()
+
+
 def test_wedged_device_dispatch_falls_back_to_host_and_latches():
     """A wedged accelerator dispatch (hung transport) must never stall a
     verify_batch caller — SCP flushes run on the main crank and ledger
