@@ -322,6 +322,51 @@ class TestPallasKernel:
         assert got.tolist() == want
 
 
+class TestPipelineAbort:
+    def test_mid_pipeline_dispatch_error_raises_not_deadlocks(self):
+        """BatchVerifier.verify's multi-chunk pipeline bounds in-flight
+        device buffers with a semaphore; a dispatch error mid-stream must
+        RAISE to the caller (with the stager unblocked), never deadlock
+        in the executor teardown (ed25519.py:399-427)."""
+        import threading
+
+        from stellar_tpu.ops.ed25519 import BatchVerifier
+
+        bv = BatchVerifier(max_batch=16)  # small chunks -> many of them
+        calls = []
+
+        def flaky(staged):
+            # hermetic: successful dispatches are stubbed (no jit compile,
+            # no 60s cold-cache dependency); only the error path is real
+            calls.append(1)
+            if len(calls) == 3:
+                raise RuntimeError("relay dropped mid-stream")
+            return np.ones(16, dtype=bool)
+
+        bv._dispatch_staged = flaky
+        items = []
+        for i in range(16 * 6):  # 6 chunks through PIPELINE_DEPTH=2
+            sk = SecretKey.pseudo_random_for_testing(i)
+            msg = b"pipeline %d" % i
+            items.append((sk.public_raw, msg, sk.sign(msg)))
+        outcome = []
+
+        def run():
+            try:
+                bv.verify(items)
+                outcome.append(("returned", None))
+            except BaseException as e:  # surfaced in the main thread below
+                outcome.append(("raised", e))
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        t.join(30)
+        assert outcome, "pipeline deadlocked instead of raising"
+        kind, exc = outcome[0]
+        assert kind == "raised", f"verify() {kind} instead of raising"
+        assert isinstance(exc, RuntimeError) and "mid-stream" in str(exc), exc
+
+
 class TestShardedVerifier:
     """End-to-end make_sharded_verifier over the 8-device CPU mesh that
     conftest.py sets up — the multi-chip data-parallel path the driver's
