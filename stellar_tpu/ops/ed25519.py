@@ -291,9 +291,11 @@ class BatchVerifier:
     kernel, scatters results; host gate failures never reach the device.
 
     ``backend="auto"`` picks the Pallas kernel (ops/ed25519_pallas.py —
-    measured 4× the XLA lowering on v5e, PROFILE.md) on a real accelerator
-    and the plain XLA kernel on CPU or when a mesh shards the batch axis
-    (pallas_call isn't jit-shardable over the mesh; the XLA kernel is)."""
+    measured 4× the XLA lowering on v5e, PROFILE.md) on a real
+    accelerator and the plain XLA kernel on CPU.  With a mesh, the Pallas
+    kernel runs PER SHARD under shard_map (each chip grids its local
+    slice of the batch; no cross-shard communication — XLA inserts only
+    the output all-gather), so multi-chip keeps the fast kernel."""
 
     def __init__(
         self,
@@ -306,18 +308,23 @@ class BatchVerifier:
         self.min_device_batch = min_device_batch
         self.mesh = mesh
         if backend == "auto":
-            # pallas is a TPU (Mosaic) lowering: not CPU, and not GPU either
-            backend = (
-                "pallas"
-                if mesh is None and jax.default_backend() == "tpu"
-                else "xla"
-            )
+            # pallas is a TPU (Mosaic) lowering: not CPU, and not GPU
+            # either (interpret mode exists but is far slower than XLA)
+            backend = "pallas" if jax.default_backend() == "tpu" else "xla"
         self.backend = backend
         if self.backend == "pallas":
             from .ed25519_pallas import NT
 
-            # every device batch must be a whole number of pallas tiles
-            self.max_batch = max(NT, (self.max_batch + NT - 1) // NT * NT)
+            # every device batch must be a whole number of pallas tiles —
+            # PER SHARD when a mesh splits the batch axis
+            n_shards = len(mesh.devices.flat) if mesh is not None else 1
+            self._granule = NT * n_shards
+            self.max_batch = max(
+                self._granule,
+                -(-self.max_batch // self._granule) * self._granule,
+            )
+        else:
+            self._granule = 1
         self._kernel = self._make_kernel()
         self.n_device_calls = 0
         self.n_items = 0
@@ -331,6 +338,33 @@ class BatchVerifier:
             batch_axis = self.mesh.axis_names[0]
             shard = NamedSharding(self.mesh, PSpec(None, batch_axis))
             vec = NamedSharding(self.mesh, PSpec(batch_axis))
+            if self.backend == "pallas":
+                from jax import shard_map
+
+                from .ed25519_pallas import verify_kernel_pallas
+
+                body = partial(
+                    verify_kernel_pallas,
+                    # per-shard pallas grids compile with Mosaic only on
+                    # real TPU; the CPU mesh (tests, driver dryrun) runs
+                    # the same kernel in interpreter mode
+                    interpret=jax.default_backend() != "tpu",
+                )
+                fn = shard_map(
+                    body,
+                    mesh=self.mesh,
+                    in_specs=(PSpec(None, batch_axis),) * 4,
+                    out_specs=PSpec(batch_axis),
+                    # pallas_call's out_shape carries no varying-mesh-axes
+                    # annotation; the per-shard kernel is trivially
+                    # batch-varying, so skip the VMA check
+                    check_vma=False,
+                )
+                return jax.jit(
+                    fn,
+                    in_shardings=(shard, shard, shard, shard),
+                    out_shardings=vec,
+                )
             return jax.jit(
                 verify_kernel,
                 in_shardings=(shard, shard, shard, shard),
@@ -344,11 +378,8 @@ class BatchVerifier:
         return jax.jit(partial(verify_kernel, batch_inv=True))
 
     def _bucket(self, n: int) -> int:
-        b = self.min_device_batch
-        if self.backend == "pallas":
-            from .ed25519_pallas import NT
-
-            b = max(b, NT)  # pallas grid tiles the batch in NT lanes
+        b = max(self.min_device_batch, self._granule)
+        b = -(-b // self._granule) * self._granule  # whole tiles per shard
         while b < n:
             b *= 2
         if self.mesh is not None:

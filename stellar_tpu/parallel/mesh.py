@@ -30,7 +30,13 @@ def make_mesh(devices: Optional[Sequence] = None, axis: str = "batch"):
 
 
 def make_sharded_verifier(mesh=None, max_batch: int = 8192, **kw):
-    """BatchVerifier whose kernel is jit-sharded over the mesh's batch axis."""
+    """BatchVerifier whose kernel is sharded over the mesh's batch axis.
+
+    On real TPU the Pallas kernel runs PER SHARD under jax.shard_map
+    (each chip grids its local batch slice; the only collective is XLA's
+    output all-gather), keeping the 4x-faster kernel at multi-chip scale;
+    on CPU meshes the XLA kernel (or interpreter-mode Pallas with
+    backend="pallas") provides the same bit-exact semantics."""
     from ..ops.ed25519 import BatchVerifier
 
     if mesh is None:

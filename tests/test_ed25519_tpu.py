@@ -395,6 +395,40 @@ class TestShardedVerifier:
         assert bv.verify(items) == want
         assert bv.n_device_calls == 1  # one coalesced sharded dispatch
 
+    def test_sharded_pallas_verifier_on_mesh(self):
+        """backend="pallas" with a mesh runs the Pallas kernel PER SHARD
+        under shard_map (interpreter mode on the CPU mesh) — the multi-
+        chip path that keeps the fast kernel on real TPU pods.  Two
+        devices bound the interpret cost (granule = 2*NT lanes)."""
+        from stellar_tpu.ops.ed25519 import BatchVerifier
+        from stellar_tpu.ops.ed25519_pallas import NT
+        from stellar_tpu.parallel.mesh import make_mesh
+
+        devs = jax.devices()
+        assert len(devs) >= 2
+        mesh = make_mesh(devs[:2], axis="batch")
+        bv = BatchVerifier(max_batch=2 * NT, mesh=mesh, backend="pallas")
+        assert bv._granule == 2 * NT
+        # an awkward min_device_batch must still bucket to whole tiles
+        odd = BatchVerifier(
+            max_batch=4 * NT, mesh=mesh, backend="pallas",
+            min_device_batch=3 * NT,
+        )
+        assert odd._bucket(1) % odd._granule == 0
+        items, expect = [], []
+        for i in range(40):
+            sk = SecretKey.pseudo_random_for_testing(700 + i)
+            msg = b"shardmap %d" % i
+            sig = sk.sign(msg)
+            if i % 4 == 1:
+                sig = sig[:13] + bytes([sig[13] ^ 1]) + sig[14:]
+                expect.append(False)
+            else:
+                expect.append(True)
+            items.append((sk.public_raw, msg, sig))
+        assert bv.verify(items) == expect
+        assert bv.n_device_calls == 1
+
     def test_dryrun_multichip_entrypoint(self):
         """The driver-facing entry must succeed regardless of caller env."""
         import sys
