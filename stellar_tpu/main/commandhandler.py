@@ -235,14 +235,25 @@ class CommandHandler:
         return h.dump_info() if h else {}
 
     def handle_tx(self, q: dict) -> dict:
-        """Submit a hex-XDR TransactionEnvelope (CommandHandler.cpp:92 'tx')."""
+        """Submit a hex-XDR TransactionEnvelope (CommandHandler.cpp:92 'tx').
+
+        A malformed blob answers ``{"exception": ...}`` as a NORMAL
+        response, like the reference's catch block
+        (CommandHandler.cpp:685-692) — submitters probing with garbage
+        must get a parseable error, not an HTTP 500."""
         from ..tx.frame import TransactionFrame
+        from ..xdr.base import XdrError
 
         blob = q.get("blob")
         if not blob:
-            raise ValueError("missing 'blob' param")
-        env = TransactionEnvelope.from_xdr(bytes.fromhex(blob))
-        tx = TransactionFrame.make_from_wire(self.app.network_id, env)
+            return {
+                "exception": "Must specify a tx blob: tx?blob=<tx in xdr format>"
+            }
+        try:
+            env = TransactionEnvelope.from_xdr(bytes.fromhex(blob))
+            tx = TransactionFrame.make_from_wire(self.app.network_id, env)
+        except (XdrError, ValueError) as e:
+            return {"exception": str(e)}
         status = self.app.herder.recv_transaction(tx)
         out = {"status": status}
         if status == "PENDING" and self.app.overlay_manager is not None:

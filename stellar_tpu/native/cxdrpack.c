@@ -37,10 +37,11 @@ typedef struct {
     int *child;           /* node indices */
     PyObject **names;     /* struct: interned attr names (owned refs) */
     PyObject *enum_set;   /* enum/union-switch: frozenset of valid ints */
+    PyObject *members;    /* enum/union-switch: dict int -> enum member */
     PyObject *arms;       /* union: dict int -> child slot int (-1 = void) */
     int sw_kind;          /* union switch: 0 = enum, 1 = int32, 2 = uint32 */
     int depth_slot;       /* K_DEPTH */
-    PyObject *cls;        /* struct/union: constructor for copy (owned) */
+    PyObject *cls;        /* struct/union: constructor for copy/unpack */
     int immutable;        /* copy may share the value (struct/union only) */
 } Node;
 
@@ -407,6 +408,282 @@ pack_node(Walk *w, int idx, PyObject *val)
     return xdr_err(w, "corrupt program: unknown node kind");
 }
 
+/* -- unpack (the from_xdr fast path) ----------------------------------- */
+/* Mirrors XdrCodec.unpack_from semantics exactly: bounds checks, zero
+ * padding, enum/bool/discriminant validation, UTF-8 strings, positional
+ * construction of struct/union classes.  Returns a new reference or NULL
+ * with XdrError set. */
+
+typedef struct {
+    const unsigned char *buf;
+    Py_ssize_t len;
+    Py_ssize_t off;
+} Rd;
+
+static PyObject *unpack_node(Walk *w, int idx, Rd *rd);
+
+static int
+rd_need(Walk *w, Rd *rd, Py_ssize_t n, const char *what)
+{
+    if (rd->off + n > rd->len)
+        return xdr_err(w, "short buffer for %s", what);
+    return 0;
+}
+
+static unsigned int
+rd_be32(Rd *rd)
+{
+    const unsigned char *p = rd->buf + rd->off;
+    rd->off += 4;
+    return ((unsigned int)p[0] << 24) | ((unsigned int)p[1] << 16) |
+           ((unsigned int)p[2] << 8) | (unsigned int)p[3];
+}
+
+static int
+rd_pad_ok(Walk *w, Rd *rd, Py_ssize_t n)
+{
+    Py_ssize_t pad = (4 - (n % 4)) % 4;
+    if (rd_need(w, rd, pad, "padding") < 0)
+        return -1;
+    for (Py_ssize_t i = 0; i < pad; i++) {
+        if (rd->buf[rd->off + i])
+            return xdr_err(w, "nonzero padding");
+    }
+    rd->off += pad;
+    return 0;
+}
+
+static PyObject *
+enum_member(Walk *w, PyObject *members, long v)
+{
+    PyObject *key = PyLong_FromLong(v);
+    if (!key)
+        return NULL;
+    PyObject *m = PyDict_GetItemWithError(members, key);
+    Py_DECREF(key);
+    if (!m) {
+        if (!PyErr_Occurred())
+            xdr_err(w, "bad enum value %ld", v);
+        return NULL;
+    }
+    Py_INCREF(m);
+    return m;
+}
+
+static PyObject *
+unpack_node(Walk *w, int idx, Rd *rd)
+{
+    Node *nd = &w->prog->nodes[idx];
+    switch (nd->kind) {
+    case K_U32: {
+        if (rd_need(w, rd, 4, "uint32") < 0)
+            return NULL;
+        return PyLong_FromUnsignedLong(rd_be32(rd));
+    }
+    case K_I32: {
+        if (rd_need(w, rd, 4, "int32") < 0)
+            return NULL;
+        return PyLong_FromLong((long)(int)rd_be32(rd));
+    }
+    case K_U64: {
+        if (rd_need(w, rd, 8, "uint64") < 0)
+            return NULL;
+        unsigned long long hi = rd_be32(rd);
+        unsigned long long lo = rd_be32(rd);
+        return PyLong_FromUnsignedLongLong((hi << 32) | lo);
+    }
+    case K_I64: {
+        if (rd_need(w, rd, 8, "int64") < 0)
+            return NULL;
+        unsigned long long hi = rd_be32(rd);
+        unsigned long long lo = rd_be32(rd);
+        return PyLong_FromLongLong((long long)((hi << 32) | lo));
+    }
+    case K_BOOL: {
+        if (rd_need(w, rd, 4, "bool") < 0)
+            return NULL;
+        unsigned int v = rd_be32(rd);
+        if (v > 1) {
+            xdr_err(w, "bad bool discriminant %u", v);
+            return NULL;
+        }
+        PyObject *out = v ? Py_True : Py_False;
+        Py_INCREF(out);
+        return out;
+    }
+    case K_ENUM: {
+        if (rd_need(w, rd, 4, "enum") < 0)
+            return NULL;
+        return enum_member(w, nd->members, (long)(int)rd_be32(rd));
+    }
+    case K_OPAQUE: {
+        if (rd_need(w, rd, nd->a, "opaque") < 0)
+            return NULL;
+        PyObject *out = PyBytes_FromStringAndSize(
+            (const char *)rd->buf + rd->off, nd->a);
+        rd->off += nd->a;
+        if (out && rd_pad_ok(w, rd, nd->a) < 0) {
+            Py_DECREF(out);
+            return NULL;
+        }
+        return out;
+    }
+    case K_VAROPAQUE:
+    case K_STRING: {
+        if (rd_need(w, rd, 4, "length") < 0)
+            return NULL;
+        unsigned int n = rd_be32(rd);
+        if (n > nd->a) {
+            xdr_err(w, "opaque<%lld> length %u", nd->a, n);
+            return NULL;
+        }
+        if (rd_need(w, rd, (Py_ssize_t)n, "var opaque") < 0)
+            return NULL;
+        PyObject *out;
+        if (nd->kind == K_STRING) {
+            out = PyUnicode_DecodeUTF8(
+                (const char *)rd->buf + rd->off, n, NULL);
+            if (!out) {
+                PyErr_Clear();
+                xdr_err(w, "invalid string bytes");
+                return NULL;
+            }
+        } else {
+            out = PyBytes_FromStringAndSize(
+                (const char *)rd->buf + rd->off, n);
+        }
+        rd->off += n;
+        if (out && rd_pad_ok(w, rd, n) < 0) {
+            Py_DECREF(out);
+            return NULL;
+        }
+        return out;
+    }
+    case K_ARRAY:
+    case K_VARARRAY: {
+        Py_ssize_t n;
+        if (nd->kind == K_ARRAY) {
+            n = nd->a;
+        } else {
+            if (rd_need(w, rd, 4, "array length") < 0)
+                return NULL;
+            unsigned int ln = rd_be32(rd);
+            if (ln > nd->a) {
+                xdr_err(w, "array<%lld> length %u", nd->a, ln);
+                return NULL;
+            }
+            n = (Py_ssize_t)ln;
+            /* hostile wire counts must fail as a SHORT BUFFER before the
+             * list preallocation (every XDR element consumes >= 4 wire
+             * bytes, so a count the buffer cannot possibly satisfy is
+             * malformed — matching the incremental Python decoder, which
+             * raises XdrError, never MemoryError, on count=0xFFFFFFFF) */
+            if (n > (rd->len - rd->off) / 4) {
+                xdr_err(w, "short buffer for array of %zd elements", n);
+                return NULL;
+            }
+        }
+        PyObject *out = PyList_New(n);
+        if (!out)
+            return NULL;
+        for (Py_ssize_t i = 0; i < n; i++) {
+            PyObject *e = unpack_node(w, nd->child[0], rd);
+            if (!e) {
+                Py_DECREF(out);
+                return NULL;
+            }
+            PyList_SET_ITEM(out, i, e);
+        }
+        return out;
+    }
+    case K_OPTION: {
+        if (rd_need(w, rd, 4, "option flag") < 0)
+            return NULL;
+        unsigned int v = rd_be32(rd);
+        if (v > 1) {
+            xdr_err(w, "bad bool discriminant %u", v);
+            return NULL;
+        }
+        if (!v)
+            Py_RETURN_NONE;
+        return unpack_node(w, nd->child[0], rd);
+    }
+    case K_STRUCT: {
+        PyObject *args = PyTuple_New(nd->nchild);
+        if (!args)
+            return NULL;
+        for (int i = 0; i < nd->nchild; i++) {
+            PyObject *f = unpack_node(w, nd->child[i], rd);
+            if (!f) {
+                Py_DECREF(args);
+                return NULL;
+            }
+            PyTuple_SET_ITEM(args, i, f);
+        }
+        PyObject *out = PyObject_CallObject(nd->cls, args);
+        Py_DECREF(args);
+        return out;
+    }
+    case K_UNION: {
+        if (rd_need(w, rd, 4, "discriminant") < 0)
+            return NULL;
+        long dv = (long)(int)rd_be32(rd);
+        PyObject *disc;
+        if (nd->sw_kind == 0) {
+            disc = enum_member(w, nd->members, dv);
+            if (!disc)
+                return NULL;
+        } else if (nd->sw_kind == 2) {
+            disc = PyLong_FromUnsignedLong((unsigned long)(unsigned int)dv);
+        } else {
+            disc = PyLong_FromLong(dv);
+        }
+        if (!disc)
+            return NULL;
+        PyObject *slot = PyDict_GetItemWithError(nd->arms, disc);
+        int child = -2; /* -2 = missing */
+        if (slot) {
+            child = (int)PyLong_AsLong(slot);
+        } else if (PyErr_Occurred()) {
+            Py_DECREF(disc);
+            return NULL;
+        } else if (!nd->a) { /* not default_void */
+            Py_DECREF(disc);
+            xdr_err(w, "bad union discriminant %ld", dv);
+            return NULL;
+        }
+        PyObject *v;
+        if (child >= 0) {
+            v = unpack_node(w, child, rd);
+            if (!v) {
+                Py_DECREF(disc);
+                return NULL;
+            }
+        } else {
+            v = Py_None;
+            Py_INCREF(v);
+        }
+        PyObject *out = PyObject_CallFunctionObjArgs(nd->cls, disc, v, NULL);
+        Py_DECREF(disc);
+        Py_DECREF(v);
+        return out;
+    }
+    case K_DEPTH: {
+        int *d = &w->depths[nd->depth_slot];
+        if (++*d > nd->a) {
+            --*d;
+            xdr_err(w, "recursion deeper than %lld", nd->a);
+            return NULL;
+        }
+        PyObject *out = unpack_node(w, nd->child[0], rd);
+        --*d;
+        return out;
+    }
+    }
+    xdr_err(w, "corrupt program: unknown node kind");
+    return NULL;
+}
+
 /* -- structural copy (the xdr_copy fast path) -------------------------- */
 /* Mirrors XdrCodec.copy semantics exactly: leaves are shared, containers
  * rebuilt, structs/unions rebuilt by POSITIONAL construction of the same
@@ -561,6 +838,7 @@ program_free(Program *p)
             PyMem_Free(nd->names);
         }
         Py_XDECREF(nd->enum_set);
+        Py_XDECREF(nd->members);
         Py_XDECREF(nd->arms);
         Py_XDECREF(nd->cls);
     }
@@ -609,9 +887,12 @@ parse_node(Program *p, int i, PyObject *spec, int *depth_counter)
     if (!strcmp(tag, "i64")) { REQ(1); nd->kind = K_I64; return 0; }
     if (!strcmp(tag, "bool")) { REQ(1); nd->kind = K_BOOL; return 0; }
     if (!strcmp(tag, "enum")) {
+        /* ("enum", members_dict) — the validation set is the dict's keys */
         REQ(2);
         nd->kind = K_ENUM;
-        nd->enum_set = build_int_set(PyTuple_GET_ITEM(spec, 1));
+        nd->members = PyTuple_GET_ITEM(spec, 1);
+        Py_INCREF(nd->members);
+        nd->enum_set = build_int_set(nd->members); /* iterates keys */
         return nd->enum_set ? 0 : -1;
     }
     if (!strcmp(tag, "opaque") || !strcmp(tag, "varopaque") ||
@@ -685,7 +966,9 @@ parse_node(Program *p, int i, PyObject *spec, int *depth_counter)
             return -1;
         if (!strcmp(swtag, "enum")) {
             nd->sw_kind = 0;
-            nd->enum_set = build_int_set(PyTuple_GET_ITEM(sw, 1));
+            nd->members = PyTuple_GET_ITEM(sw, 1);
+            Py_INCREF(nd->members);
+            nd->enum_set = build_int_set(nd->members); /* iterates keys */
             if (!nd->enum_set)
                 return -1;
         } else if (!strcmp(swtag, "i32")) {
@@ -833,6 +1116,32 @@ cxdr_copy(PyObject *self, PyObject *args)
     return copy_node(&w, p->root, val);
 }
 
+static PyObject *
+cxdr_unpack(PyObject *self, PyObject *args)
+{
+    PyObject *cap;
+    Py_buffer data;
+    if (!PyArg_ParseTuple(args, "Oy*", &cap, &data))
+        return NULL;
+    Program *p = PyCapsule_GetPointer(cap, "cxdrpack.program");
+    if (!p) {
+        PyBuffer_Release(&data);
+        return NULL;
+    }
+    Walk w;
+    memset(&w, 0, sizeof w);
+    w.prog = p;
+    Rd rd = {data.buf, data.len, 0};
+    PyObject *out = unpack_node(&w, p->root, &rd);
+    if (out && rd.off != rd.len) {
+        Py_DECREF(out);
+        out = NULL;
+        xdr_err(&w, "trailing bytes: consumed %zd of %zd", rd.off, rd.len);
+    }
+    PyBuffer_Release(&data);
+    return out;
+}
+
 static PyMethodDef methods[] = {
     {"compile", cxdr_compile, METH_VARARGS,
      "compile(defs_list, root_index, xdr_error_cls) -> program capsule"},
@@ -840,6 +1149,9 @@ static PyMethodDef methods[] = {
      "pack(program, value) -> bytes"},
     {"copy", cxdr_copy, METH_VARARGS,
      "copy(program, value) -> structural copy sharing immutable subtrees"},
+    {"unpack", cxdr_unpack, METH_VARARGS,
+     "unpack(program, bytes) -> decoded value; XdrError on malformed or"
+     " trailing bytes"},
     {NULL, NULL, 0, NULL},
 };
 

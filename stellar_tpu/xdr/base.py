@@ -104,6 +104,11 @@ class XdrCodec:
         return bytes(out)
 
     def unpack(self, data: bytes) -> Any:
+        prog = self._cprog
+        if prog is None:
+            prog = self._compile_cprog()
+        if prog is not False:
+            return _cxdr().unpack(prog, data)
         val, off = self.unpack_from(data, 0)
         if off != len(data):
             raise XdrError(f"trailing bytes: consumed {off} of {len(data)}")
@@ -846,7 +851,9 @@ def _cspec_of(codec: XdrCodec, defs: List[Any], memo: Dict[int, int]) -> int:
     elif isinstance(codec, _Bool):
         spec = ("bool",)
     elif isinstance(codec, _Enum):
-        spec = ("enum", tuple(sorted(codec.enum_cls._value2member_map_)))
+        # one source of truth: the C side derives its validation set from
+        # the member map's keys
+        spec = ("enum", dict(codec.enum_cls._value2member_map_))
     elif isinstance(codec, _Opaque):
         spec = ("opaque", codec.n)
     elif isinstance(codec, _String):  # before _VarOpaque: subclass
@@ -866,10 +873,7 @@ def _cspec_of(codec: XdrCodec, defs: List[Any], memo: Dict[int, int]) -> int:
     elif isinstance(codec, _UnionCodec):
         sw = codec.switch_codec
         if isinstance(sw, _Enum):
-            sw_spec: Any = (
-                "enum",
-                tuple(sorted(sw.enum_cls._value2member_map_)),
-            )
+            sw_spec: Any = ("enum", dict(sw.enum_cls._value2member_map_))
         elif isinstance(sw, _Int32):
             sw_spec = ("i32",)
         elif isinstance(sw, _UInt32):

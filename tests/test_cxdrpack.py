@@ -127,6 +127,104 @@ def test_c_copy_is_independent():
     assert len(val.signers) == len(dup.signers) - 1
 
 
+def _py_unpack(codec, data):
+    val, off = codec.unpack_from(data, 0)
+    assert off == len(data)
+    return val
+
+
+@pytest.mark.parametrize("cls", TYPES, ids=lambda c: c.__name__)
+def test_c_unpack_matches_python_unpack(cls):
+    """from_xdr's C path: decoded objects equal the Python decoder's and
+    re-pack to the identical octets."""
+    rng = random.Random(_seed(cls) ^ 2)
+    codec = codec_of(cls)
+    for _ in range(15):
+        val = arbitrary.arbitrary(codec, size=8, rng=rng)
+        data = _py_pack(codec, val)
+        got = codec.unpack(data)  # C path
+        want = _py_unpack(codec, data)
+        assert got == want, cls.__name__
+        assert _py_pack(codec, got) == data
+
+
+class TestUnpackFailureContract:
+    def _codec(self):
+        from stellar_tpu.xdr.entries import AccountEntry
+
+        return codec_of(AccountEntry)
+
+    def _payload(self):
+        c = self._codec()
+        val = arbitrary.arbitrary(
+            c, size=4, rng=random.Random(21)
+        )
+        return c, _py_pack(c, val)
+
+    def test_truncated(self):
+        c, data = self._payload()
+        for cut in (1, 4, len(data) // 2, len(data) - 1):
+            with pytest.raises(XdrError):
+                c.unpack(data[:cut])
+
+    def test_trailing_bytes(self):
+        c, data = self._payload()
+        with pytest.raises(XdrError, match="trailing"):
+            c.unpack(data + b"\x00\x00\x00\x00")
+
+    def test_nonzero_padding(self):
+        from stellar_tpu.xdr.base import var_opaque
+
+        blob = var_opaque(64).pack(b"abc")  # 3 bytes + 1 pad byte
+        bad = blob[:-1] + b"\x07"
+        vo = var_opaque(64)
+        vo._cprog = None  # standalone codec: force fresh compile
+        with pytest.raises(XdrError):
+            vo.unpack(bad)
+        with pytest.raises(XdrError):
+            vo.unpack_from(bad, 0)
+
+    def test_hostile_vararray_count_is_short_buffer(self):
+        """count=0xFFFFFFFF on an unbounded vararray must raise XdrError
+        (short buffer), never attempt a 34 GB list preallocation."""
+        from stellar_tpu.xdr.base import uint32, var_array
+
+        va = var_array(uint32)
+        va._cprog = None
+        with pytest.raises(XdrError):
+            va.unpack(b"\xff\xff\xff\xff")
+        from stellar_tpu.xdr.scp import SCPQuorumSet
+
+        # wire-reachable shape: quorum set claiming 2^32-1 validators
+        blob = b"\x00\x00\x00\x01" + b"\xff\xff\xff\xff"
+        with pytest.raises(XdrError):
+            codec_of(SCPQuorumSet).unpack(blob)
+
+    def test_bad_enum_on_wire(self):
+        from stellar_tpu.xdr.entries import AssetType
+
+        a = X.Asset.native()
+        data = codec_of(a).pack(a)
+        bad = b"\x00\x00\x00\x63" + data[4:]  # discriminant 99
+        with pytest.raises(XdrError):
+            codec_of(a).unpack(bad)
+
+    def test_unpack_recursion_depth_bounded(self):
+        """Hand-crafted wire bytes of a 12-deep quorum set: both decoders
+        must hit the depth guard, not RecursionError."""
+        import struct as _struct
+
+        from stellar_tpu.xdr.scp import SCPQuorumSet
+
+        blob = _struct.pack(">III", 1, 0, 0)  # innermost: no inner sets
+        for _ in range(12):
+            blob = _struct.pack(">III", 1, 0, 1) + blob
+        with pytest.raises(XdrError, match="recursion"):
+            codec_of(SCPQuorumSet).unpack(blob)  # C path
+        with pytest.raises(XdrError, match="recursion"):
+            codec_of(SCPQuorumSet).unpack_from(blob, 0)  # python path
+
+
 class TestFailureContract:
     def test_bad_enum_value(self):
         env = X.TransactionEnvelope(
