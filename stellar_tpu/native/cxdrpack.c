@@ -36,7 +36,6 @@ typedef struct {
     int nchild;
     int *child;           /* node indices */
     PyObject **names;     /* struct: interned attr names (owned refs) */
-    PyObject *enum_set;   /* enum/union-switch: frozenset of valid ints */
     PyObject *members;    /* enum/union-switch: dict int -> enum member */
     PyObject *arms;       /* union: dict int -> child slot int (-1 = void) */
     int sw_kind;          /* union switch: 0 = enum, 1 = int32, 2 = uint32 */
@@ -218,7 +217,7 @@ pack_node(Walk *w, int idx, PyObject *val)
         long long v;
         if (as_longlong(w, val, &v, "enum") < 0)
             return -1;
-        int has = PySet_Contains(nd->enum_set, val);
+        int has = PyDict_Contains(nd->members, val);
         if (has < 0)
             return -1;
         if (!has)
@@ -346,7 +345,7 @@ pack_node(Walk *w, int idx, PyObject *val)
             return -1;
         }
         if (nd->sw_kind == 0) {
-            int has = PySet_Contains(nd->enum_set, disc);
+            int has = PyDict_Contains(nd->members, disc);
             if (has < 0) {
                 Py_DECREF(disc);
                 return -1;
@@ -837,7 +836,6 @@ program_free(Program *p)
             }
             PyMem_Free(nd->names);
         }
-        Py_XDECREF(nd->enum_set);
         Py_XDECREF(nd->members);
         Py_XDECREF(nd->arms);
         Py_XDECREF(nd->cls);
@@ -851,13 +849,6 @@ static void
 capsule_destroy(PyObject *cap)
 {
     program_free(PyCapsule_GetPointer(cap, "cxdrpack.program"));
-}
-
-static PyObject *
-build_int_set(PyObject *values_tuple)
-{
-    PyObject *s = PyFrozenSet_New(values_tuple);
-    return s;
 }
 
 /* Parse one node spec tuple into nodes[i].  Returns 0 / -1. */
@@ -891,9 +882,13 @@ parse_node(Program *p, int i, PyObject *spec, int *depth_counter)
         REQ(2);
         nd->kind = K_ENUM;
         nd->members = PyTuple_GET_ITEM(spec, 1);
+        if (!PyDict_Check(nd->members)) {
+            PyErr_SetString(PyExc_ValueError, "enum members must be a dict");
+            nd->members = NULL;
+            return -1;
+        }
         Py_INCREF(nd->members);
-        nd->enum_set = build_int_set(nd->members); /* iterates keys */
-        return nd->enum_set ? 0 : -1;
+        return 0;
     }
     if (!strcmp(tag, "opaque") || !strcmp(tag, "varopaque") ||
         !strcmp(tag, "string")) {
@@ -967,10 +962,13 @@ parse_node(Program *p, int i, PyObject *spec, int *depth_counter)
         if (!strcmp(swtag, "enum")) {
             nd->sw_kind = 0;
             nd->members = PyTuple_GET_ITEM(sw, 1);
-            Py_INCREF(nd->members);
-            nd->enum_set = build_int_set(nd->members); /* iterates keys */
-            if (!nd->enum_set)
+            if (!PyDict_Check(nd->members)) {
+                PyErr_SetString(PyExc_ValueError,
+                                "enum members must be a dict");
+                nd->members = NULL;
                 return -1;
+            }
+            Py_INCREF(nd->members);
         } else if (!strcmp(swtag, "i32")) {
             nd->sw_kind = 1;
         } else if (!strcmp(swtag, "u32")) {

@@ -747,7 +747,7 @@ def xdr_to_opaque(*items: Any) -> bytes:
     out = bytearray()
     for it in items:
         if isinstance(it, tuple) and len(it) == 2 and isinstance(it[0], XdrCodec):
-            it[0].pack_into(it[1], out)
+            out += it[0].pack(it[1])  # .pack takes the C path when compiled
         elif isinstance(it, enum.IntEnum):
             xenum(type(it)).pack_into(it, out)
         elif isinstance(it, (bytes, bytearray)):
@@ -758,7 +758,7 @@ def xdr_to_opaque(*items: Any) -> bytes:
                 )
             _Opaque(32).pack_into(bytes(it), out)
         else:
-            codec_of(it).pack_into(it, out)
+            out += codec_of(it).pack(it)
     return bytes(out)
 
 
