@@ -145,6 +145,10 @@ class TpuSigBackend(SigBackend):
         self.n_cutover_items = 0
         self.n_wedge_fallback_items = 0
         self._wedged_until = 0.0
+        # verify_batch is called concurrently (async signature prewarm
+        # worker + the SCP crank); the latch read/write and the budget
+        # choice go under one small lock so callers see consistent state
+        self._wedge_lock = threading.Lock()
 
     # A wedged device dispatch (e.g. accelerator transport outage) must
     # never stall a caller indefinitely — SCP envelope flushes run on the
@@ -165,7 +169,17 @@ class TpuSigBackend(SigBackend):
         if len(items) < self.cpu_cutover:
             self.n_cutover_items += len(items)
             return _sodium_verify_loop(items)
-        if time.monotonic() < self._wedged_until:
+        # the lock covers only the latch read/write and the budget choice —
+        # never the verify work itself, or every concurrent caller inherits
+        # the slowest batch's host-verify latency
+        with self._wedge_lock:
+            wedged = time.monotonic() < self._wedged_until
+            # every caller keeps the long budget until the first device call
+            # has COMPLETED (not merely been dispatched): a second caller
+            # arriving mid-compile rides the same XLA compile and must not
+            # false-latch a healthy device with the short budget
+            first = self._verifier.n_device_calls == 0
+        if wedged:
             self.n_wedge_fallback_items += len(items)
             return _sodium_verify_loop(items)
         result: List[Any] = [None]
@@ -182,13 +196,10 @@ class TpuSigBackend(SigBackend):
 
         t = threading.Thread(target=work, name="tpu-verify", daemon=True)
         t.start()
-        timeout = (
-            self.DEVICE_FIRST_TIMEOUT
-            if self._verifier.n_device_calls == 0
-            else self.DEVICE_TIMEOUT
-        )
+        timeout = self.DEVICE_FIRST_TIMEOUT if first else self.DEVICE_TIMEOUT
         if not done.wait(timeout):
-            self._wedged_until = time.monotonic() + self.RETRY_INTERVAL
+            with self._wedge_lock:
+                self._wedged_until = time.monotonic() + self.RETRY_INTERVAL
             self.n_wedge_fallback_items += len(items)
             _log.warning(
                 "device verify batch stalled >%.0fs; finishing %d verifies"

@@ -576,7 +576,11 @@ unpack_node(Walk *w, int idx, Rd *rd)
              * list preallocation (every XDR element consumes >= 4 wire
              * bytes, so a count the buffer cannot possibly satisfy is
              * malformed — matching the incremental Python decoder, which
-             * raises XdrError, never MemoryError, on count=0xFFFFFFFF) */
+             * raises XdrError, never MemoryError, on count=0xFFFFFFFF).
+             * The >=4 assumption is ENFORCED at compile time: _cspec_of
+             * (xdr/base.py) raises _CUnsupported for any vararray whose
+             * element's minimum wire size is under 4 bytes, keeping such
+             * codecs on the Python path. */
             if (n > (rd->len - rd->off) / 4) {
                 xdr_err(w, "short buffer for array of %zd elements", n);
                 return NULL;

@@ -332,7 +332,17 @@ class TransactionFrame:
                 for op in self.operations:
                     with op_timer.time_scope():
                         op_delta = LedgerDelta(outer=this_tx_delta)
-                        ok = op.apply(op_delta, app)
+                        try:
+                            ok = op.apply(op_delta, app)
+                        except BaseException:
+                            # EntryFrame stores hit the shared decoded-entry
+                            # cache immediately, before op_delta.commit()
+                            # lifts the keys into this_tx_delta — if apply
+                            # dies mid-op only op_delta knows those keys, so
+                            # its rollback must flush them or the caller's
+                            # txINTERNAL_ERROR path leaves stale cache lines
+                            op_delta.rollback()
+                            raise
                     if not ok:
                         error_encountered = True
                     meta.value.append(OperationMeta(op_delta.get_changes()))

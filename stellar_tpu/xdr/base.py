@@ -17,7 +17,7 @@ import dataclasses
 import enum
 import struct
 from dataclasses import dataclass
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 __all__ = [
     "XdrError",
@@ -89,6 +89,20 @@ class XdrCodec:
             root = _cspec_of(self, defs, {})
             prog = mod.compile(defs, root, XdrError)
         except _CUnsupported:
+            prog = False
+        except ValueError as e:
+            # mod.compile's own limits (e.g. >MAX_DEPTH_SLOTS depth guards)
+            # — degrade to the Python path and latch _cprog=False so we
+            # don't re-raise on every call.  ValueError also covers
+            # malformed specs (a _cspec_of bug), so the fallback must be
+            # loud: the C fast path silently turning off would surface
+            # only as an unexplained perf regression.
+            import logging
+
+            logging.getLogger("stellar_tpu.xdr").warning(
+                "C codec compile failed for %s (%s); using Python path",
+                type(self).__name__, e,
+            )
             prog = False
         self._cprog = prog
         return prog
@@ -829,6 +843,45 @@ class _CUnsupported(Exception):
     """Codec shape the C interpreter does not model."""
 
 
+def _min_wire_size(codec: XdrCodec, _seen: Optional[Set[int]] = None) -> int:
+    """Conservative lower bound on the serialized size (bytes) of one value
+    of `codec`.  Validates the C unpacker's hostile-count guard at compile
+    time (see the _VarArray branch of _cspec_of).  Recursion cycles
+    contribute 0, which can only under-estimate — i.e. reject a codec the
+    C path could have handled, never accept one it can't."""
+    if _seen is None:
+        _seen = set()
+    if id(codec) in _seen:
+        return 0
+    _seen.add(id(codec))
+    try:
+        if isinstance(codec, (_UInt32, _Int32, _Bool, _Enum)):
+            return 4
+        if isinstance(codec, (_UInt64, _Int64)):
+            return 8
+        if isinstance(codec, _Opaque):
+            return (codec.n + 3) // 4 * 4
+        if isinstance(codec, (_String, _VarOpaque, _VarArray, _Option)):
+            return 4  # count / discriminant alone
+        if isinstance(codec, _Array):
+            return codec.n * _min_wire_size(codec.elem, _seen)
+        if isinstance(codec, _StructCodec):
+            return sum(_min_wire_size(c, _seen) for _, c in codec.fields)
+        if isinstance(codec, _UnionCodec):
+            arms = [
+                0 if c is None else _min_wire_size(c, _seen)
+                for c in codec.arms.values()
+            ]
+            if codec.default_void or not arms:
+                arms.append(0)
+            return 4 + min(arms)
+        if isinstance(codec, DepthLimited):
+            return 0 if codec.inner is None else _min_wire_size(codec.inner, _seen)
+    finally:
+        _seen.discard(id(codec))
+    return 0  # unknown codec: conservative
+
+
 def _cspec_of(codec: XdrCodec, defs: List[Any], memo: Dict[int, int]) -> int:
     """Append the compiled spec of `codec` (and its children) to `defs`,
     returning its slot index.  `memo` closes recursive codec cycles
@@ -863,6 +916,14 @@ def _cspec_of(codec: XdrCodec, defs: List[Any], memo: Dict[int, int]) -> int:
     elif isinstance(codec, _Array):
         spec = ("array", codec.n, _cspec_of(codec.elem, defs, memo))
     elif isinstance(codec, _VarArray):
+        if _min_wire_size(codec.elem) < 4:
+            # the C unpacker's hostile-count guard (cxdrpack.c
+            # rd_check_count: n > remaining/4) assumes every element
+            # occupies >= 4 wire bytes; a zero/short-sized element
+            # (fieldless struct, opaque[0], array[T,0]) would make it
+            # reject streams the Python decoder accepts — keep such
+            # codecs on the Python path
+            raise _CUnsupported("vararray element min wire size < 4")
         spec = ("vararray", codec.maxlen, _cspec_of(codec.elem, defs, memo))
     elif isinstance(codec, _Option):
         spec = ("option", _cspec_of(codec.elem, defs, memo))

@@ -225,6 +225,58 @@ class TestUnpackFailureContract:
             codec_of(SCPQuorumSet).unpack_from(blob, 0)  # python path
 
 
+class TestCompileGuards:
+    """Compile-side degradation: shapes the C interpreter can't model (or
+    refuses) must fall back to the Python codec, never raise or diverge
+    (advisor r04 findings #2 and #3)."""
+
+    def test_short_element_vararray_stays_python(self):
+        """opaque[0] / array[T,0] elements have minimum wire size 0; the C
+        unpacker's count guard assumes >= 4 bytes/element, so these codecs
+        must be rejected at compile time and served by the Python path."""
+        from stellar_tpu.xdr.base import array, opaque, uint32, var_array
+
+        for elem, vals in (
+            (opaque(0), [b"", b"", b""]),
+            (array(uint32, 0), [[], []]),
+        ):
+            va = var_array(elem, 8)
+            data = va.pack(vals)
+            assert va._cprog is False, "C path must refuse short elements"
+            assert va.unpack(data) == vals
+
+    def test_min_wire_size_model(self):
+        from stellar_tpu.xdr.base import (
+            _min_wire_size, array, codec_of, opaque, option, uint32, uint64,
+            var_opaque,
+        )
+        from stellar_tpu.xdr.scp import SCPQuorumSet
+
+        assert _min_wire_size(uint32) == 4
+        assert _min_wire_size(uint64) == 8
+        assert _min_wire_size(opaque(0)) == 0
+        assert _min_wire_size(opaque(3)) == 4  # padded
+        assert _min_wire_size(array(uint32, 0)) == 0
+        assert _min_wire_size(var_opaque(64)) == 4  # count alone
+        assert _min_wire_size(option(opaque(0))) == 4
+        # recursive type: terminates, and is >= 4 (threshold + two counts)
+        assert _min_wire_size(codec_of(SCPQuorumSet)) >= 4
+
+    def test_compile_valueerror_degrades_to_python(self):
+        """A codec tree with more depth guards than the C interpreter's
+        MAX_DEPTH_SLOTS: mod.compile raises ValueError, which must latch
+        _cprog=False and degrade to the Python path — not escape pack()."""
+        from stellar_tpu.xdr.base import DepthLimited, uint32
+
+        c = uint32
+        for _ in range(17):  # cxdrpack.c MAX_DEPTH_SLOTS == 16
+            c = DepthLimited(c, max_depth=32)
+        data = c.pack(7)
+        assert c._cprog is False
+        assert c.unpack(data) == 7
+        assert c.pack(9) == b"\x00\x00\x00\x09"  # stays on Python path
+
+
 class TestFailureContract:
     def test_bad_enum_value(self):
         env = X.TransactionEnvelope(

@@ -671,6 +671,7 @@ def test_wedged_device_dispatch_falls_back_to_host_and_latches():
     be.n_cutover_items = 0
     be.n_wedge_fallback_items = 0
     be._wedged_until = 0.0
+    be._wedge_lock = threading.Lock()
     be.DEVICE_TIMEOUT = 0.2
 
     class WedgedVerifier:
@@ -727,3 +728,47 @@ def test_start_rejects_zero_threshold_quorum(clock):
             a.start()
     finally:
         a.database.close()
+
+
+class TestMidOpFaultCacheConsistency:
+    """Advisor r04 (medium, tx/frame.py): an op that stores an entry and
+    then raises a non-rollback exception must not leave the stored value in
+    the shared decoded-entry cache — the savepoint rollback undoes the SQL
+    row, and the in-flight op_delta's rollback must flush the cache line,
+    or later loads in the same close read rolled-back state."""
+
+    def test_cache_flushed_when_op_raises_mid_apply(self, app, root):
+        from stellar_tpu.ledger.accountframe import AccountFrame
+        from stellar_tpu.ledger.delta import LedgerDelta
+
+        a1 = T.get_account("midopfault")
+        fund(app, root, a1)
+        pk = a1.get_public_key()
+        before = AccountFrame.load_account(pk, app.database).get_balance()
+        seq = AccountFrame.load_account(pk, app.database).get_seq_num()
+
+        lm = app.ledger_manager
+        tx = T.tx_from_ops(app, a1, seq + 1, [T.payment_op(root, 100)])
+        fee = tx.envelope.tx.fee
+
+        def poisoned(op_delta, app_):
+            frame = AccountFrame.load_account(pk, app_.database)
+            frame.account.balance -= 777
+            frame.store_change(op_delta, app_.database)  # cache written NOW
+            raise RuntimeError("injected mid-op fault")
+
+        with app.database.transaction():
+            delta = LedgerDelta(lm.current.header, app.database)
+            tx.process_fee_seq_num(delta, lm)  # reset_results rebuilds ops
+            tx.operations[0].apply = poisoned
+            with pytest.raises(RuntimeError, match="mid-op fault"):
+                tx.apply(delta, app)
+            delta.commit()  # fee/seq consumption survives, like the close
+
+        # cache-visible load must equal committed state: fee charged, the
+        # -777 mutation gone from BOTH the DB (savepoint) and the cache
+        acct = AccountFrame.load_account(pk, app.database)
+        assert acct.get_balance() == before - fee
+        # prove the DB row agrees with what the cache served
+        app.database._entry_cache.clear()
+        assert AccountFrame.load_account(pk, app.database).get_balance() == before - fee
