@@ -95,17 +95,31 @@ class Database:
         else:
             self._sp_counter += 1
             sp = f"sp_{self._sp_counter}"
+            # the write-back entry store buffer (ledger/storebuffer.py)
+            # mirrors the savepoint stack: buffered entry writes unwind in
+            # lockstep with the (row-less) SQL savepoint.  Only savepoints
+            # opened while the buffer is active get a mark — the enclosing
+            # BEGIN predates activation and unwinds via buffer.deactivate()
+            buf = getattr(self, "_store_buffer", None)
+            if buf is not None and not buf.active:
+                buf = None
             self._conn.execute(f"SAVEPOINT {sp}")
+            if buf is not None:
+                buf.push_mark()
             self._tx_depth += 1
             try:
                 yield self
             except BaseException:
                 self._tx_depth -= 1
+                if buf is not None:
+                    buf.rollback_mark()
                 self._conn.execute(f"ROLLBACK TO SAVEPOINT {sp}")
                 self._conn.execute(f"RELEASE SAVEPOINT {sp}")
                 raise
             else:
                 self._tx_depth -= 1
+                if buf is not None:
+                    buf.release_mark()
                 self._conn.execute(f"RELEASE SAVEPOINT {sp}")
 
     @property

@@ -16,8 +16,10 @@ from ..xdr.entries import (
     Signer,
     ThresholdIndexes,
 )
+from ..xdr.base import xdr_copy
 from ..xdr.ledger import LedgerKey, LedgerKeyAccount
-from .entryframe import EntryFrame
+from .entryframe import EntryFrame, key_bytes
+from .storebuffer import active_buffer
 
 
 _ACCT_KEY_PREFIX = LedgerKey(
@@ -136,6 +138,11 @@ class AccountFrame(EntryFrame):
     def process_for_inflation(db, max_winners: int):
         """[(votes, inflation_dest_pk)] — vote tally grouped by inflationdest,
         min 100 XLM balance to vote (AccountFrame::processForInflation)."""
+        buf = active_buffer(db)
+        if buf is not None:
+            # an aggregate over ALL accounts can't read through the overlay
+            # — write pending rows inside the current savepoint first
+            buf.flush_through(db)
         rows = db.query_all(
             "SELECT sum(balance) AS votes, inflationdest FROM accounts"
             " WHERE inflationdest IS NOT NULL AND balance >= 1000000000"
@@ -186,6 +193,13 @@ class AccountFrame(EntryFrame):
         hit, cached = cls.cache_of(db).get(kb)
         if hit:
             return cls(cached) if cached else None
+        buf = active_buffer(db)
+        if buf is not None:
+            # pending write evicted from the LRU: the overlay, not SQL, is
+            # authoritative for any key it holds
+            hit, pending = buf.get(kb)
+            if hit:
+                return cls(xdr_copy(pending)) if pending is not None else None
         aid = _aid(account_id)
         with db.timed("select", "account"):
             row = db.query_one(
@@ -236,6 +250,8 @@ class AccountFrame(EntryFrame):
         at 10^6-account scale random payment destinations made every load
         a point SELECT against a deep B-tree (PROFILE.md round-4 ladder —
         the 2.6x cliff's dominant term)."""
+        # runs before the store buffer activates (close_ledger warms first,
+        # then turns the buffer on), so SQL rows are never stale here
         cache = cls.cache_of(db)
         todo = []
         for pk in account_ids:
@@ -299,6 +315,11 @@ class AccountFrame(EntryFrame):
 
     @classmethod
     def exists(cls, db, key: LedgerKey) -> bool:
+        buf = active_buffer(db)
+        if buf is not None:
+            hit, pending = buf.get(key_bytes(key))
+            if hit:
+                return pending is not None
         return (
             db.query_one(
                 "SELECT 1 FROM accounts WHERE accountid=?",
@@ -366,17 +387,64 @@ class AccountFrame(EntryFrame):
             )
 
     def store_delete(self, delta, db) -> None:
-        aid = _aid(self.account.accountID)
-        with db.timed("delete", "account"):
-            db.execute("DELETE FROM accounts WHERE accountid=?", (aid,))
-        db.execute("DELETE FROM signers WHERE accountid=?", (aid,))
+        if not self._buffered_delete(db, self.get_key()):
+            aid = _aid(self.account.accountID)
+            with db.timed("delete", "account"):
+                db.execute("DELETE FROM accounts WHERE accountid=?", (aid,))
+            db.execute("DELETE FROM signers WHERE accountid=?", (aid,))
         delta.delete_entry_frame(self)
         self.store_in_cache(db, self.get_key(), None)
 
     @classmethod
     def store_delete_by_key(cls, delta, db, key: LedgerKey) -> None:
-        aid = _aid(key.value.accountID)
-        db.execute("DELETE FROM accounts WHERE accountid=?", (aid,))
-        db.execute("DELETE FROM signers WHERE accountid=?", (aid,))
+        if not cls._buffered_delete(db, key):
+            aid = _aid(key.value.accountID)
+            db.execute("DELETE FROM accounts WHERE accountid=?", (aid,))
+            db.execute("DELETE FROM signers WHERE accountid=?", (aid,))
         delta.delete_entry(key)
         cls.store_in_cache(db, key, None)
+
+    # -- store-buffer flush (ledger/storebuffer.py) ------------------------
+    _UPSERT_SQL = (
+        "INSERT OR REPLACE INTO accounts (balance, seqnum, numsubentries,"
+        " inflationdest, homedomain, thresholds, flags, lastmodified,"
+        " accountid) VALUES (?,?,?,?,?,?,?,?,?)"
+    )
+
+    @classmethod
+    def upsert_batch(cls, db, entries) -> None:
+        rows, aids, signer_rows = [], [], []
+        for e in entries:
+            a = e.data.value
+            aid = _aid(a.accountID)
+            aids.append((aid,))
+            rows.append((
+                a.balance,
+                a.seqNum,
+                a.numSubEntries,
+                _aid(a.inflationDest) if a.inflationDest else None,
+                a.homeDomain,
+                base64.b64encode(a.thresholds).decode(),
+                a.flags,
+                e.lastModifiedLedgerSeq,
+                aid,
+            ))
+            signer_rows.extend(
+                (aid, _aid(s.pubKey), s.weight) for s in a.signers
+            )
+        with db.timed("flush", "account"):
+            db.executemany(cls._UPSERT_SQL, rows)
+            db.executemany("DELETE FROM signers WHERE accountid=?", aids)
+            if signer_rows:
+                db.executemany(
+                    "INSERT INTO signers (accountid, publickey, weight)"
+                    " VALUES (?,?,?)",
+                    signer_rows,
+                )
+
+    @classmethod
+    def delete_batch(cls, db, keys) -> None:
+        aids = [(_aid(k.value.accountID),) for k in keys]
+        with db.timed("flush", "account"):
+            db.executemany("DELETE FROM accounts WHERE accountid=?", aids)
+            db.executemany("DELETE FROM signers WHERE accountid=?", aids)

@@ -14,6 +14,7 @@ from typing import Optional
 from ..xdr.base import xdr_copy
 from ..xdr.entries import LedgerEntry, LedgerEntryType
 from ..xdr.ledger import LedgerKey
+from .storebuffer import active_buffer
 
 
 class EntryCache:
@@ -120,12 +121,14 @@ class EntryFrame:
     # -- store interface ---------------------------------------------------
     def store_add(self, delta, db) -> None:
         self._stamp(delta)
-        self._persist(db, insert=True)
+        if active_buffer(db) is None:
+            self._persist(db, insert=True)
         self._record(delta, db, created=True)
 
     def store_change(self, delta, db) -> None:
         self._stamp(delta)
-        self._persist(db, insert=False)
+        if active_buffer(db) is None:
+            self._persist(db, insert=False)
         self._record(delta, db, created=False)
 
     def _persist(self, db, insert: bool) -> None:
@@ -134,21 +137,45 @@ class EntryFrame:
     def store_delete(self, delta, db) -> None:
         raise NotImplementedError
 
+    @classmethod
+    def _buffered_delete(cls, db, key: LedgerKey) -> bool:
+        """Route a delete into the active store buffer; False = caller must
+        issue the SQL itself (write-through mode)."""
+        buf = active_buffer(db)
+        if buf is None:
+            return False
+        buf.record(key_bytes(key), key, None, cls)
+        return True
+
+    # -- batched flush (EntryStoreBuffer) ----------------------------------
+    @classmethod
+    def upsert_batch(cls, db, entries) -> None:
+        raise NotImplementedError
+
+    @classmethod
+    def delete_batch(cls, db, keys) -> None:
+        raise NotImplementedError
+
     # -- shared plumbing ---------------------------------------------------
     def _stamp(self, delta) -> None:
         if delta.update_last_modified:
             self.last_modified = delta.header_ro().ledgerSeq
 
     def _record(self, delta, db, *, created: bool) -> None:
-        """After a SQL write: record the entry in the delta AND the entry
-        cache with ONE shared immutable snapshot (both sides only read)."""
+        """After a (possibly buffered) write: record the entry in the delta,
+        the entry cache, and the active store buffer with ONE shared
+        immutable snapshot (all sides only read)."""
         key = self.get_key()
         snap = xdr_copy(self.entry)
         if created:
             delta.add_entry_snapshot(key, snap)
         else:
             delta.mod_entry_snapshot(key, snap)
-        entry_cache_of(db).put_owned(key_bytes(key), snap)
+        kb = key_bytes(key)
+        entry_cache_of(db).put_owned(kb, snap)
+        buf = active_buffer(db)
+        if buf is not None:
+            buf.record(kb, key, snap, type(self))
 
     @staticmethod
     def cache_of(db) -> EntryCache:

@@ -15,8 +15,10 @@ from ..xdr.entries import (
     Price,
     PublicKey,
 )
+from ..xdr.base import xdr_copy
 from ..xdr.ledger import LedgerKey, LedgerKeyOffer
-from .entryframe import EntryFrame
+from .entryframe import EntryFrame, key_bytes
+from .storebuffer import active_buffer
 from .trustframe import asset_from_cols, asset_to_cols
 
 
@@ -140,6 +142,11 @@ class OfferFrame(EntryFrame):
         hit, cached = cls.cache_of(db).get(key.to_xdr())
         if hit:
             return cls(cached) if cached else None
+        buf = active_buffer(db)
+        if buf is not None:
+            hit, pending = buf.get(key_bytes(key))
+            if hit:
+                return cls(xdr_copy(pending)) if pending is not None else None
         with db.timed("select", "offer"):
             row = db.query_one(
                 f"SELECT {cls._COLS} FROM offers WHERE sellerid=? AND offerid=?",
@@ -173,17 +180,53 @@ class OfferFrame(EntryFrame):
         )
         params: list = [satype] if selling.is_native() else [satype, saissuer, sacode]
         params += [batype] if buying.is_native() else [batype, baissuer, bacode]
-        params += [num, offset]
+
+        buf = active_buffer(db)
+        touched = None
+        if buf is not None:
+            pending_entries, touched = buf.pending_offers()
+        if not touched:
+            with db.timed("select", "offer"):
+                rows = db.query_all(
+                    f"SELECT {cls._COLS} FROM offers WHERE {cond_s} AND {cond_b} "
+                    "ORDER BY price, offerid LIMIT ? OFFSET ?",
+                    params + [num, offset],
+                )
+            return [cls._row_to_frame(r) for r in rows]
+
+        # overlay merge: the buffer is authoritative for every touched
+        # offerid, so drop those rows from the SQL scan and splice the
+        # pending upserts in.  Over-fetch by len(touched) so the merged
+        # window [offset, offset+num) is still fully covered after the
+        # exclusions (OfferExchange pages with a cursor offset that
+        # assumes crossed offers vanish — with buffered deletes they
+        # vanish from the merged view instead of the table).
         with db.timed("select", "offer"):
             rows = db.query_all(
                 f"SELECT {cls._COLS} FROM offers WHERE {cond_s} AND {cond_b} "
-                "ORDER BY price, offerid LIMIT ? OFFSET ?",
-                params,
+                "ORDER BY price, offerid LIMIT ?",
+                params + [offset + num + len(touched)],
             )
-        return [cls._row_to_frame(r) for r in rows]
+        frames = [cls._row_to_frame(r) for r in rows if r[1] not in touched]
+        for e in pending_entries:
+            o = e.data.value
+            if o.selling == selling and o.buying == buying:
+                frames.append(cls(xdr_copy(e)))
+        # the SQL sort key is (price DOUBLE, offerid) where price was
+        # computed as n/d in Python at write time — recomputing here gives
+        # the identical IEEE double, so the merged order matches what the
+        # write-through table scan would have returned (consensus-critical)
+        frames.sort(key=lambda f: (f.offer.price.n / f.offer.price.d,
+                                   f.offer.offerID))
+        return frames[offset : offset + num]
 
     @classmethod
     def exists(cls, db, key: LedgerKey) -> bool:
+        buf = active_buffer(db)
+        if buf is not None:
+            hit, pending = buf.get(key_bytes(key))
+            if hit:
+                return pending is not None
         return (
             db.query_one(
                 "SELECT 1 FROM offers WHERE sellerid=? AND offerid=?",
@@ -247,13 +290,45 @@ class OfferFrame(EntryFrame):
                 )
 
     def store_delete(self, delta, db) -> None:
-        with db.timed("delete", "offer"):
-            db.execute("DELETE FROM offers WHERE offerid=?", (self.offer.offerID,))
+        if not self._buffered_delete(db, self.get_key()):
+            with db.timed("delete", "offer"):
+                db.execute(
+                    "DELETE FROM offers WHERE offerid=?", (self.offer.offerID,)
+                )
         delta.delete_entry_frame(self)
         self.store_in_cache(db, self.get_key(), None)
 
     @classmethod
     def store_delete_by_key(cls, delta, db, key) -> None:
-        db.execute("DELETE FROM offers WHERE offerid=?", (key.value.offerID,))
+        if not cls._buffered_delete(db, key):
+            db.execute("DELETE FROM offers WHERE offerid=?", (key.value.offerID,))
         delta.delete_entry(key)
         cls.store_in_cache(db, key, None)
+
+    # -- store-buffer flush (ledger/storebuffer.py) ------------------------
+    @classmethod
+    def upsert_batch(cls, db, entries) -> None:
+        rows = []
+        for e in entries:
+            o = e.data.value
+            satype, saissuer, sacode = asset_to_cols(o.selling)
+            batype, baissuer, bacode = asset_to_cols(o.buying)
+            rows.append((
+                _aid(o.sellerID), o.offerID, satype, sacode, saissuer,
+                batype, bacode, baissuer, o.amount, o.price.n, o.price.d,
+                o.price.n / o.price.d, o.flags, e.lastModifiedLedgerSeq,
+            ))
+        with db.timed("flush", "offer"):
+            db.executemany(
+                f"INSERT OR REPLACE INTO offers ({cls._COLS})"
+                " VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
+                rows,
+            )
+
+    @classmethod
+    def delete_batch(cls, db, keys) -> None:
+        with db.timed("flush", "offer"):
+            db.executemany(
+                "DELETE FROM offers WHERE offerid=?",
+                [(k.value.offerID,) for k in keys],
+            )

@@ -15,8 +15,10 @@ from ..xdr.entries import (
     TrustLineEntry,
     TrustLineFlags,
 )
+from ..xdr.base import xdr_copy
 from ..xdr.ledger import LedgerKey, LedgerKeyTrustLine
-from .entryframe import EntryFrame
+from .entryframe import EntryFrame, key_bytes
+from .storebuffer import active_buffer
 
 
 def _aid(pk: PublicKey) -> str:
@@ -156,6 +158,11 @@ class TrustFrame(EntryFrame):
         hit, cached = cls.cache_of(db).get(key.to_xdr())
         if hit:
             return cls(cached) if cached else None
+        buf = active_buffer(db)
+        if buf is not None:
+            hit, pending = buf.get(key_bytes(key))
+            if hit:
+                return cls(xdr_copy(pending)) if pending is not None else None
         _, issuer, code = asset_to_cols(asset)
         with db.timed("select", "trust"):
             row = db.query_one(
@@ -174,6 +181,11 @@ class TrustFrame(EntryFrame):
 
     @classmethod
     def exists(cls, db, key: LedgerKey) -> bool:
+        buf = active_buffer(db)
+        if buf is not None:
+            hit, pending = buf.get(key_bytes(key))
+            if hit:
+                return pending is not None
         _, issuer, code = asset_to_cols(key.value.asset)
         return (
             db.query_one(
@@ -241,22 +253,56 @@ class TrustFrame(EntryFrame):
 
     def store_delete(self, delta, db) -> None:
         assert not self.is_issuer
-        tl = self.trust_line
-        _, issuer, code = asset_to_cols(tl.asset)
-        with db.timed("delete", "trust"):
-            db.execute(
-                "DELETE FROM trustlines WHERE accountid=? AND issuer=? AND assetcode=?",
-                (_aid(tl.accountID), issuer, code),
-            )
+        if not self._buffered_delete(db, self.get_key()):
+            tl = self.trust_line
+            _, issuer, code = asset_to_cols(tl.asset)
+            with db.timed("delete", "trust"):
+                db.execute(
+                    "DELETE FROM trustlines WHERE accountid=? AND issuer=? AND assetcode=?",
+                    (_aid(tl.accountID), issuer, code),
+                )
         delta.delete_entry_frame(self)
         self.store_in_cache(db, self.get_key(), None)
 
     @classmethod
     def store_delete_by_key(cls, delta, db, key) -> None:
-        _, issuer, code = asset_to_cols(key.value.asset)
-        db.execute(
-            "DELETE FROM trustlines WHERE accountid=? AND issuer=? AND assetcode=?",
-            (_aid(key.value.accountID), issuer, code),
-        )
+        if not cls._buffered_delete(db, key):
+            _, issuer, code = asset_to_cols(key.value.asset)
+            db.execute(
+                "DELETE FROM trustlines WHERE accountid=? AND issuer=? AND assetcode=?",
+                (_aid(key.value.accountID), issuer, code),
+            )
         delta.delete_entry(key)
         cls.store_in_cache(db, key, None)
+
+    # -- store-buffer flush (ledger/storebuffer.py) ------------------------
+    @classmethod
+    def upsert_batch(cls, db, entries) -> None:
+        rows = []
+        for e in entries:
+            tl = e.data.value
+            atype, issuer, code = asset_to_cols(tl.asset)
+            rows.append((
+                _aid(tl.accountID), atype, issuer, code, tl.limit,
+                tl.balance, tl.flags, e.lastModifiedLedgerSeq,
+            ))
+        with db.timed("flush", "trust"):
+            db.executemany(
+                "INSERT OR REPLACE INTO trustlines (accountid, assettype,"
+                " issuer, assetcode, tlimit, balance, flags, lastmodified)"
+                " VALUES (?,?,?,?,?,?,?,?)",
+                rows,
+            )
+
+    @classmethod
+    def delete_batch(cls, db, keys) -> None:
+        rows = []
+        for k in keys:
+            _, issuer, code = asset_to_cols(k.value.asset)
+            rows.append((_aid(k.value.accountID), issuer, code))
+        with db.timed("flush", "trust"):
+            db.executemany(
+                "DELETE FROM trustlines WHERE accountid=? AND issuer=?"
+                " AND assetcode=?",
+                rows,
+            )

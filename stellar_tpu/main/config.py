@@ -77,6 +77,13 @@ class Config:
         from ..crypto.sigbackend import DEFAULT_TPU_CPU_CUTOVER
 
         self.TPU_CPU_CUTOVER = DEFAULT_TPU_CPU_CUTOVER
+        # TPU-native addition: write-back entry store buffer during ledger
+        # close — entry mutations accumulate in an overlay (reads see
+        # through it) and flush as batched SQL once per close instead of
+        # ~8 statements per applied tx (ledger/storebuffer.py).  Off =
+        # reference-style write-through; the differential close tests run
+        # both and compare ledger hashes.
+        self.ENTRY_WRITE_BUFFER = True
 
     # -- loading -----------------------------------------------------------
     @classmethod

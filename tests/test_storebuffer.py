@@ -1,0 +1,323 @@
+"""Write-back entry store buffer (ledger/storebuffer.py).
+
+The buffer replaces per-store SQL on the close path with an authoritative
+overlay + one batched flush.  The reference has no such layer — its
+EntryFrame writes through (src/ledger/EntryFrame.h:23-79) — so the contract
+here is equivalence: a node with ENTRY_WRITE_BUFFER=on must produce
+bit-identical ledgers AND bit-identical SQL state to one with it off, for
+every entry type, through rollbacks, crossings, deletes, and aggregate
+reads.
+"""
+
+import pytest
+
+import stellar_tpu.xdr as X
+from stellar_tpu.crypto import SecretKey
+from stellar_tpu.main.application import Application
+from stellar_tpu.tx import testutils as T
+from stellar_tpu.util import VIRTUAL_TIME, VirtualClock
+
+RC = X.TransactionResultCode
+
+
+@pytest.fixture
+def clock():
+    c = VirtualClock(VIRTUAL_TIME)
+    yield c
+    c.shutdown()
+
+
+def _dump_entry_tables(db):
+    out = {}
+    for table, order in (
+        ("accounts", "accountid"),
+        ("signers", "accountid, publickey"),
+        ("trustlines", "accountid, issuer, assetcode"),
+        ("offers", "offerid"),
+    ):
+        out[table] = db.query_all(f"SELECT * FROM {table} ORDER BY {order}")
+    return out
+
+
+class _ScenarioRunner:
+    """Drive the same close sequence through two apps (buffer on / off) and
+    compare ledger hashes + raw SQL state after every close."""
+
+    def __init__(self, clock, instance_base):
+        self.apps = []
+        for i, buffered in enumerate((True, False)):
+            cfg = T.get_test_config(instance_base + i)
+            cfg.ENTRY_WRITE_BUFFER = buffered
+            cfg.PARANOID_MODE = True  # audit every close on both sides
+            self.apps.append(Application(clock, cfg, new_db=True))
+
+    def close(self, build_txs):
+        """build_txs(app, root) -> [TransactionFrame]; closes both apps."""
+        results = []
+        for app in self.apps:
+            lm = app.ledger_manager
+            txs = build_txs(app, T.root_key_for(app))
+            T.close_ledger_on(
+                app, lm.last_closed.header.scpValue.closeTime + 5, txs
+            )
+            results.append(
+                [tx.get_result_code() for tx in txs]
+            )
+        buf_app, ref_app = self.apps
+        assert results[0] == results[1], "tx result codes diverged"
+        assert (
+            buf_app.ledger_manager.last_closed.hash
+            == ref_app.ledger_manager.last_closed.hash
+        ), "ledger hash diverged"
+        assert _dump_entry_tables(buf_app.database) == _dump_entry_tables(
+            ref_app.database
+        ), "SQL entry state diverged"
+        return results[0]
+
+    def shutdown(self):
+        for app in self.apps:
+            app.database.close()
+
+
+@pytest.fixture
+def runner(clock):
+    r = _ScenarioRunner(clock, 60)
+    yield r
+    r.shutdown()
+
+
+def _seq(app, sk):
+    """Next usable seqNum for `sk` (current account seq + 1)."""
+    from stellar_tpu.ledger.accountframe import AccountFrame
+
+    return AccountFrame.load_account(
+        sk.get_public_key(), app.database
+    ).get_seq_num() + 1
+
+
+def test_differential_payments_and_fees(runner):
+    a, b = T.get_account("wbuf-a"), T.get_account("wbuf-b")
+    runner.close(lambda app, root: [
+        T.tx_from_ops(app, root, _seq(app, root), [
+            T.create_account_op(a, 10**12), T.create_account_op(b, 10**12),
+        ]),
+    ])
+    codes = runner.close(lambda app, root: [
+        T.tx_from_ops(app, a, _seq(app, a), [T.payment_op(b, 10**7)]),
+        T.tx_from_ops(app, b, _seq(app, b), [T.payment_op(a, 3 * 10**6)]),
+        # failed tx: underfunded payment rolls back mid-close
+        T.tx_from_ops(app, a, _seq(app, a) + 1, [T.payment_op(b, 10**15)]),
+    ])
+    assert codes[:2] == [RC.txSUCCESS, RC.txSUCCESS]
+    assert codes[2] == RC.txFAILED
+
+
+def test_differential_offer_create_and_cross_same_close(runner):
+    """tx1 creates an order book, tx2 crosses it IN THE SAME CLOSE — the
+    buffered side's load_best_offers must see tx1's pending offers through
+    the overlay merge, take them in the identical order, and delete/modify
+    identically."""
+    a, b = T.get_account("wbuf-sell"), T.get_account("wbuf-buy")
+    runner.close(lambda app, root: [
+        T.tx_from_ops(app, root, _seq(app, root), [
+            T.create_account_op(a, 10**12), T.create_account_op(b, 10**12),
+        ]),
+    ])
+
+    def mk_usd(app):
+        return X.Asset.alphanum4(b"USD", T.root_key_for(app).get_public_key())
+
+    runner.close(lambda app, root: [
+        T.tx_from_ops(app, a, _seq(app, a), [T.change_trust_op(mk_usd(app), 10**12)]),
+        T.tx_from_ops(app, b, _seq(app, b), [T.change_trust_op(mk_usd(app), 10**12)]),
+    ])
+    # fund in a separate close: txset apply order is shuffled, so the USD
+    # payment must not race b's change_trust within one set
+    runner.close(lambda app, root: [
+        T.tx_from_ops(app, root, _seq(app, root), [
+            T.payment_op(b, 10**10, asset=mk_usd(app)),
+        ]),
+    ])
+    codes = runner.close(lambda app, root: [
+        # a sells XLM for USD at three price levels (same close)
+        T.tx_from_ops(app, a, _seq(app, a), [
+            T.manage_offer_op(X.Asset.native(), mk_usd(app), 10**8, X.Price(2, 1)),
+            T.manage_offer_op(X.Asset.native(), mk_usd(app), 10**8, X.Price(3, 1)),
+            T.manage_offer_op(X.Asset.native(), mk_usd(app), 10**8, X.Price(4, 1)),
+        ]),
+        # b crosses: takes level 1 fully and level 2 partially
+        T.tx_from_ops(app, b, _seq(app, b), [
+            T.manage_offer_op(mk_usd(app), X.Asset.native(), 45 * 10**7,
+                              X.Price(1, 3)),
+        ]),
+    ])
+    assert codes == [RC.txSUCCESS, RC.txSUCCESS]
+    # and a later close still agrees (residual book state identical)
+    codes = runner.close(lambda app, root: [
+        T.tx_from_ops(app, b, _seq(app, b), [
+            T.manage_offer_op(mk_usd(app), X.Asset.native(), 10**9,
+                              X.Price(1, 4)),
+        ]),
+    ])
+    assert codes == [RC.txSUCCESS]
+
+
+def test_differential_signers_delete_and_inflation(runner):
+    """SetOptions signers (the signers side-table), AccountMerge (delete
+    batch), and Inflation (aggregate query → flush_through) in closes."""
+    a, b = T.get_account("wbuf-sig"), T.get_account("wbuf-victim")
+    s1 = T.get_account("wbuf-signer")
+    runner.close(lambda app, root: [
+        T.tx_from_ops(app, root, _seq(app, root), [
+            T.create_account_op(a, 10**12), T.create_account_op(b, 10**11),
+        ]),
+    ])
+    codes = runner.close(lambda app, root: [
+        T.tx_from_ops(app, a, _seq(app, a), [
+            T.set_options_op(signer=X.Signer(s1.get_public_key(), 1)),
+        ]),
+        T.tx_from_ops(app, b, _seq(app, b), [T.merge_op(a)]),
+    ])
+    assert codes == [RC.txSUCCESS, RC.txSUCCESS]
+    codes = runner.close(lambda app, root: [
+        T.tx_from_ops(app, a, _seq(app, a), [
+            T.set_options_op(inflation_dest=a.get_public_key()),
+        ]),
+    ])
+    assert codes == [RC.txSUCCESS]
+    # inflation: process_for_inflation aggregates over accounts — the
+    # buffered side must flush_through inside the close before tallying
+    codes = runner.close(lambda app, root: [
+        T.tx_from_ops(app, a, _seq(app, a), [T.payment_op(root, 10**6)]),
+        T.tx_from_ops(app, root, _seq(app, root), [T.inflation_op()]),
+    ])
+    assert codes[0] == RC.txSUCCESS
+
+
+class TestBufferMechanics:
+    def _buf(self):
+        from stellar_tpu.ledger.storebuffer import EntryStoreBuffer
+
+        return EntryStoreBuffer()
+
+    def _key(self, n):
+        from stellar_tpu.xdr.entries import LedgerEntryType, PublicKey
+        from stellar_tpu.xdr.ledger import LedgerKey, LedgerKeyAccount
+
+        pk = PublicKey.from_ed25519(bytes([n]) * 32)
+        return LedgerKey(LedgerEntryType.ACCOUNT, LedgerKeyAccount(pk))
+
+    def test_overlay_and_mark_unwind(self):
+        buf = self._buf()
+        buf.activate()
+        k1, k2 = self._key(1), self._key(2)
+        buf.record(b"k1", k1, "v1", object)
+        buf.push_mark()
+        buf.record(b"k1", k1, "v2", object)  # overwrite inside savepoint
+        buf.record(b"k2", k2, None, object)  # delete inside savepoint
+        assert buf.get(b"k1") == (True, "v2")
+        assert buf.get(b"k2") == (True, None)
+        buf.rollback_mark()
+        assert buf.get(b"k1") == (True, "v1")  # restored
+        assert buf.get(b"k2") == (False, None)  # gone
+        buf.deactivate()
+
+    def test_nested_marks_release_keeps_outer_scope(self):
+        buf = self._buf()
+        buf.activate()
+        k1 = self._key(1)
+        buf.push_mark()  # outer savepoint
+        buf.push_mark()  # inner savepoint
+        buf.record(b"k1", k1, "inner", object)
+        buf.release_mark()  # inner commits into outer scope
+        buf.rollback_mark()  # outer rolls back: inner's write must unwind
+        assert buf.get(b"k1") == (False, None)
+        buf.deactivate()
+
+    def test_flush_through_survives_enclosing_rollback(self, clock):
+        """Mid-close flush (inflation) inside a savepoint that then rolls
+        back: SQL undoes the rows, the undo log restores the overlay."""
+        cfg = T.get_test_config(68)
+        app = Application(clock, cfg, new_db=True)
+        try:
+            from stellar_tpu.ledger.accountframe import AccountFrame
+            from stellar_tpu.ledger.delta import LedgerDelta
+            from stellar_tpu.ledger.storebuffer import store_buffer_of
+
+            from stellar_tpu.ledger.entryframe import key_bytes
+
+            root = T.root_key_for(app)
+            db = app.database
+            lm = app.ledger_manager
+            pk = root.get_public_key()
+            balance0 = AccountFrame.load_account(pk, db).get_balance()
+            with db.transaction():
+                buf = store_buffer_of(db)
+                buf.activate()
+                try:
+                    # pending write made BEFORE the savepoint: must survive
+                    # the savepoint's rollback as a pending write
+                    delta0 = LedgerDelta(lm.current.header, db)
+                    f0 = AccountFrame.load_account(pk, db)
+                    f0.account.balance -= 111
+                    f0.store_change(delta0, db)
+                    kb = key_bytes(f0.get_key())
+                    with pytest.raises(RuntimeError, match="boom"):
+                        with db.transaction():  # savepoint w/ mark
+                            delta = LedgerDelta(lm.current.header, db)
+                            f = AccountFrame.load_account(pk, db)
+                            f.account.balance -= 12345
+                            f.store_change(delta, db)
+                            buf.flush_through(db)  # rows land in savepoint
+                            assert not buf._overlay
+                            raise RuntimeError("boom")
+                    # savepoint rolled back: SQL undid the flushed rows and
+                    # the undo log re-instated exactly the pre-savepoint
+                    # pending state — the in-savepoint -12345 is gone, the
+                    # pre-savepoint -111 is pending again
+                    hit, pending = buf.get(kb)
+                    assert hit
+                    assert pending.data.value.balance == balance0 - 111
+                    row = db.query_one(
+                        "SELECT balance FROM accounts WHERE accountid=?",
+                        (root.get_strkey_public(),),
+                    )
+                    assert row[0] == balance0, "savepoint must undo the flush"
+                finally:
+                    buf.deactivate()
+            db._entry_cache.clear()
+            assert AccountFrame.load_account(pk, db).get_balance() == balance0
+        finally:
+            app.database.close()
+
+    def test_close_uses_buffer_and_skips_per_store_sql(self, clock):
+        """The point of the buffer: a buffered close issues no per-entry
+        INSERT/UPDATE statements, only the batched flush."""
+        cfg = T.get_test_config(69)
+        app = Application(clock, cfg, new_db=True)
+        try:
+            root = T.root_key_for(app)
+            a = T.get_account("wbuf-count")
+            lm = app.ledger_manager
+            from stellar_tpu.ledger.accountframe import AccountFrame
+
+            calls = []
+            orig = AccountFrame._persist
+            AccountFrame._persist = lambda self, db, insert: calls.append(1)
+            try:
+                T.close_ledger_on(
+                    app,
+                    lm.last_closed.header.scpValue.closeTime + 5,
+                    [T.tx_from_ops(app, root, _seq(app, root),
+                                   [T.create_account_op(a, 10**10)])],
+                )
+            finally:
+                AccountFrame._persist = orig
+            assert not calls, "buffered close must not write per-store SQL"
+            buf = app.database._store_buffer
+            assert buf.n_buffered_writes > 0 and buf.n_flushes == 1
+            # the flush landed: rows are queryable post-close
+            assert AccountFrame.load_account(a.get_public_key(),
+                                             app.database) is not None
+        finally:
+            app.database.close()

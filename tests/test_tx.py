@@ -627,17 +627,46 @@ def test_paranoid_mode_audits_every_close(clock):
         # negative: the audit exists to catch a delta/SQL divergence bug —
         # simulate a "missed SQL write" (the delta and cache record the
         # new entry, the row never lands) and the close must raise instead
-        # of committing divergent state
+        # of committing divergent state.  With ENTRY_WRITE_BUFFER on the
+        # per-tx write path is the batched flush (upsert_batch); drop the
+        # target's row there.
         from stellar_tpu.ledger.accountframe import AccountFrame
 
-        orig_persist = AccountFrame._persist
+        orig_upsert = AccountFrame.upsert_batch.__func__
         dropped = []
         target = a.get_public_key()  # the payment DEST: its only write
 
+        def flaky_upsert(cls, db, entries):
+            kept = []
+            for e in entries:
+                if e.data.value.accountID == target and not dropped:
+                    dropped.append(target)
+                    continue  # lose exactly one row from the flush
+                kept.append(e)
+            orig_upsert(cls, db, kept)
+
+        AccountFrame.upsert_batch = classmethod(flaky_upsert)
+        try:
+            bad = [T.tx_from_ops(app, b, (2 << 32) + 2,
+                                 [T.payment_op(a, 10**6)])]
+            with pytest.raises(RuntimeError, match="delta-vs-database"):
+                T.close_ledger_on(
+                    app, lm.last_closed.header.scpValue.closeTime + 5, bad
+                )
+        finally:
+            AccountFrame.upsert_batch = classmethod(orig_upsert)
+        assert dropped, "the fault was never injected"
+
+        # same audit, write-through plane: with the buffer off the per-store
+        # _persist is the write path — lose one there instead
+        app.config.ENTRY_WRITE_BUFFER = False
+        orig_persist = AccountFrame._persist
+        dropped2 = []
+
         def flaky_persist(self, db, insert):
-            if self.get_id() == target and not dropped:
-                dropped.append(self.get_id())
-                return  # lose exactly one SQL write (no later write masks it)
+            if self.get_id() == target and not dropped2:
+                dropped2.append(self.get_id())
+                return
             orig_persist(self, db, insert)
 
         AccountFrame._persist = flaky_persist
@@ -650,7 +679,7 @@ def test_paranoid_mode_audits_every_close(clock):
                 )
         finally:
             AccountFrame._persist = orig_persist
-        assert dropped, "the fault was never injected"
+        assert dropped2, "the write-through fault was never injected"
     finally:
         app.database.close()
 
