@@ -346,9 +346,13 @@ class AccountFrame(EntryFrame):
         self._normalize()
         super().store_change(delta, db)
 
-    def _persist(self, db, insert: bool) -> None:
-        a = self.account
-        params = (
+    @staticmethod
+    def _sql_row(a, lastmod: int):
+        """The one accounts-row serialization — shared by the per-store
+        _persist path and the store-buffer's batched upsert so the two
+        write modes can never drift (consensus-critical: PARANOID_MODE
+        audits decoded rows against the delta)."""
+        return (
             a.balance,
             a.seqNum,
             a.numSubEntries,
@@ -356,9 +360,13 @@ class AccountFrame(EntryFrame):
             a.homeDomain,
             base64.b64encode(a.thresholds).decode(),
             a.flags,
-            self.last_modified,
+            lastmod,
             _aid(a.accountID),
         )
+
+    def _persist(self, db, insert: bool) -> None:
+        a = self.account
+        params = self._sql_row(a, self.last_modified)
         if insert:
             with db.timed("insert", "account"):
                 db.execute(
@@ -416,19 +424,10 @@ class AccountFrame(EntryFrame):
         rows, aids, signer_rows = [], [], []
         for e in entries:
             a = e.data.value
-            aid = _aid(a.accountID)
+            row = cls._sql_row(a, e.lastModifiedLedgerSeq)
+            aid = row[-1]
             aids.append((aid,))
-            rows.append((
-                a.balance,
-                a.seqNum,
-                a.numSubEntries,
-                _aid(a.inflationDest) if a.inflationDest else None,
-                a.homeDomain,
-                base64.b64encode(a.thresholds).decode(),
-                a.flags,
-                e.lastModifiedLedgerSeq,
-                aid,
-            ))
+            rows.append(row)
             signer_rows.extend(
                 (aid, _aid(s.pubKey), s.weight) for s in a.signers
             )

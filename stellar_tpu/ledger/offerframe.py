@@ -207,18 +207,23 @@ class OfferFrame(EntryFrame):
                 "ORDER BY price, offerid LIMIT ?",
                 params + [offset + num + len(touched)],
             )
-        frames = [cls._row_to_frame(r) for r in rows if r[1] not in touched]
+        # the SQL sort key is (price DOUBLE, offerid) where price was
+        # computed as n/d in Python at write time (_sql_row) — recomputing
+        # it for pending entries gives the identical IEEE double, so the
+        # merged order matches what the write-through table scan would
+        # have returned (consensus-critical).  Sort raw and slice BEFORE
+        # decoding: only the <=num surviving rows pay _row_to_frame, not
+        # the whole offset+num+touched over-fetch on every cursor page.
+        merged = [((r[11], r[1]), r, None) for r in rows if r[1] not in touched]
         for e in pending_entries:
             o = e.data.value
             if o.selling == selling and o.buying == buying:
-                frames.append(cls(xdr_copy(e)))
-        # the SQL sort key is (price DOUBLE, offerid) where price was
-        # computed as n/d in Python at write time — recomputing here gives
-        # the identical IEEE double, so the merged order matches what the
-        # write-through table scan would have returned (consensus-critical)
-        frames.sort(key=lambda f: (f.offer.price.n / f.offer.price.d,
-                                   f.offer.offerID))
-        return frames[offset : offset + num]
+                merged.append(((o.price.n / o.price.d, o.offerID), None, e))
+        merged.sort(key=lambda t: t[0])
+        return [
+            cls._row_to_frame(r) if r is not None else cls(xdr_copy(e))
+            for _, r, e in merged[offset : offset + num]
+        ]
 
     @classmethod
     def exists(cls, db, key: LedgerKey) -> bool:
@@ -235,32 +240,28 @@ class OfferFrame(EntryFrame):
             is not None
         )
 
-    def _persist(self, db, insert: bool) -> None:
-        o = self.offer
+    @staticmethod
+    def _sql_row(o, lastmod: int):
+        """The one offers-row serialization, in _COLS order — shared by
+        _persist and the store-buffer's batched upsert so the two write
+        modes can never drift.  The `price` double (n/d in Python) is the
+        SQL ORDER BY key, so it must come from exactly one place."""
         satype, saissuer, sacode = asset_to_cols(o.selling)
         batype, baissuer, bacode = asset_to_cols(o.buying)
-        price_approx = o.price.n / o.price.d
+        return (
+            _aid(o.sellerID), o.offerID, satype, sacode, saissuer,
+            batype, bacode, baissuer, o.amount, o.price.n, o.price.d,
+            o.price.n / o.price.d, o.flags, lastmod,
+        )
+
+    def _persist(self, db, insert: bool) -> None:
+        row = self._sql_row(self.offer, self.last_modified)
         if insert:
             with db.timed("insert", "offer"):
                 db.execute(
                     f"""INSERT INTO offers ({self._COLS})
                         VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?)""",
-                    (
-                        _aid(o.sellerID),
-                        o.offerID,
-                        satype,
-                        sacode,
-                        saissuer,
-                        batype,
-                        bacode,
-                        baissuer,
-                        o.amount,
-                        o.price.n,
-                        o.price.d,
-                        price_approx,
-                        o.flags,
-                        self.last_modified,
-                    ),
+                    row,
                 )
         else:
             # every mutable column, assets included — ManageOffer update may
@@ -272,21 +273,7 @@ class OfferFrame(EntryFrame):
                        buyingassetcode=?, buyingissuer=?, amount=?, pricen=?,
                        priced=?, price=?, flags=?, lastmodified=?
                        WHERE offerid=?""",
-                    (
-                        satype,
-                        sacode,
-                        saissuer,
-                        batype,
-                        bacode,
-                        baissuer,
-                        o.amount,
-                        o.price.n,
-                        o.price.d,
-                        price_approx,
-                        o.flags,
-                        self.last_modified,
-                        o.offerID,
-                    ),
+                    row[2:] + (row[1],),
                 )
 
     def store_delete(self, delta, db) -> None:
@@ -308,16 +295,10 @@ class OfferFrame(EntryFrame):
     # -- store-buffer flush (ledger/storebuffer.py) ------------------------
     @classmethod
     def upsert_batch(cls, db, entries) -> None:
-        rows = []
-        for e in entries:
-            o = e.data.value
-            satype, saissuer, sacode = asset_to_cols(o.selling)
-            batype, baissuer, bacode = asset_to_cols(o.buying)
-            rows.append((
-                _aid(o.sellerID), o.offerID, satype, sacode, saissuer,
-                batype, bacode, baissuer, o.amount, o.price.n, o.price.d,
-                o.price.n / o.price.d, o.flags, e.lastModifiedLedgerSeq,
-            ))
+        rows = [
+            cls._sql_row(e.data.value, e.lastModifiedLedgerSeq)
+            for e in entries
+        ]
         with db.timed("flush", "offer"):
             db.executemany(
                 f"INSERT OR REPLACE INTO offers ({cls._COLS})"

@@ -195,25 +195,28 @@ class TrustFrame(EntryFrame):
             is not None
         )
 
-    def _persist(self, db, insert: bool) -> None:
-        tl = self.trust_line
+    @staticmethod
+    def _sql_row(tl, lastmod: int):
+        """The one trustlines-row serialization, in INSERT column order —
+        shared by _persist and the store-buffer's batched upsert so the
+        two write modes can never drift."""
         atype, issuer, code = asset_to_cols(tl.asset)
+        return (
+            _aid(tl.accountID), atype, issuer, code,
+            tl.limit, tl.balance, tl.flags, lastmod,
+        )
+
+    def _persist(self, db, insert: bool) -> None:
+        aid, atype, issuer, code, tlimit, balance, flags, lastmod = (
+            self._sql_row(self.trust_line, self.last_modified)
+        )
         if insert:
             with db.timed("insert", "trust"):
                 db.execute(
                     """INSERT INTO trustlines (accountid, assettype, issuer,
                        assetcode, tlimit, balance, flags, lastmodified)
                        VALUES (?,?,?,?,?,?,?,?)""",
-                    (
-                        _aid(tl.accountID),
-                        atype,
-                        issuer,
-                        code,
-                        tl.limit,
-                        tl.balance,
-                        tl.flags,
-                        self.last_modified,
-                    ),
+                    (aid, atype, issuer, code, tlimit, balance, flags, lastmod),
                 )
         else:
             with db.timed("update", "trust"):
@@ -221,16 +224,7 @@ class TrustFrame(EntryFrame):
                     """UPDATE trustlines SET assettype=?, tlimit=?, balance=?,
                        flags=?, lastmodified=?
                        WHERE accountid=? AND issuer=? AND assetcode=?""",
-                    (
-                        atype,
-                        tl.limit,
-                        tl.balance,
-                        tl.flags,
-                        self.last_modified,
-                        _aid(tl.accountID),
-                        issuer,
-                        code,
-                    ),
+                    (atype, tlimit, balance, flags, lastmod, aid, issuer, code),
                 )
 
     @classmethod
@@ -278,14 +272,10 @@ class TrustFrame(EntryFrame):
     # -- store-buffer flush (ledger/storebuffer.py) ------------------------
     @classmethod
     def upsert_batch(cls, db, entries) -> None:
-        rows = []
-        for e in entries:
-            tl = e.data.value
-            atype, issuer, code = asset_to_cols(tl.asset)
-            rows.append((
-                _aid(tl.accountID), atype, issuer, code, tl.limit,
-                tl.balance, tl.flags, e.lastModifiedLedgerSeq,
-            ))
+        rows = [
+            cls._sql_row(e.data.value, e.lastModifiedLedgerSeq)
+            for e in entries
+        ]
         with db.timed("flush", "trust"):
             db.executemany(
                 "INSERT OR REPLACE INTO trustlines (accountid, assettype,"

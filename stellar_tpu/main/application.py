@@ -125,10 +125,23 @@ class Application:
             or self.persistent_state.get_state(K_FORCE_SCP_ON_NEXT_LAUNCH) == "true"
         )
         if self.herder is not None:
+            # ALWAYS restore the last SCP statements first — even a force
+            # -started node must rebroadcast them so a peer that missed the
+            # externalize can close the previous ledger (the reference
+            # restores before the FORCE_SCP bootstrap,
+            # ApplicationImpl.cpp:254,263-279; HerderTests "SCP State"
+            # depends on it)
+            self.herder.restore_scp_state()
             if force:
+                if (
+                    self.persistent_state.get_state(K_FORCE_SCP_ON_NEXT_LAUNCH)
+                    == "true"
+                ):
+                    # one-shot flag, cleared once used (ApplicationImpl.cpp:268)
+                    self.persistent_state.set_state(
+                        K_FORCE_SCP_ON_NEXT_LAUNCH, "false"
+                    )
                 self.herder.bootstrap()
-            else:
-                self.herder.restore_scp_state()
         if self.overlay_manager is not None and not self.config.RUN_STANDALONE:
             self.overlay_manager.start()
         if self.command_handler is not None:

@@ -43,14 +43,19 @@ class Simulation:
         qset: SCPQuorumSet,
         cfg=None,
         new_db: bool = True,
+        force_scp: bool = True,
     ) -> Application:
+        """force_scp=False models the reference's restart-without-FORCE_SCP
+        (HerderTests.cpp "No Force SCP"): the node restores its last SCP
+        statements from the DB and rebroadcasts, but does not start new
+        rounds until it hears consensus."""
         if cfg is None:
             cfg = get_test_config(self._next_instance)
         self._next_instance += 1
         cfg.NODE_SEED = secret
         cfg.NODE_IS_VALIDATOR = True
         cfg.QUORUM_SET = qset
-        cfg.FORCE_SCP = True
+        cfg.FORCE_SCP = force_scp
         cfg.MANUAL_CLOSE = False
         cfg.RUN_STANDALONE = self.mode == OVER_LOOPBACK
         cfg.HTTP_PORT = 0

@@ -77,6 +77,7 @@ class TestStandaloneConsensus:
         assert load_or_none(app, dest).get_balance() == amount
 
     def test_recv_transaction_statuses(self, clock):
+        """HerderTests.cpp:158-214 ("recvTx")."""
         app = make_scp_app(clock)
         app.herder.bootstrap()
         dest = T.get_account("tx-status-dest")
@@ -402,3 +403,64 @@ class TestSurgePricing:
         assert len(ts.transactions) == 5
         assert ts.check_valid(app)
         app.graceful_stop()
+
+
+class TestCombineCandidates:
+    def test_composite_value_selection(self, clock):
+        """HerderTests.cpp:507-560 — combineCandidates builds the composite
+        StellarValue: max closeTime across candidates, biggest txset wins
+        (a later candidate with a higher closeTime but smaller txset moves
+        the closeTime without displacing the bigger set)."""
+        from stellar_tpu.herder.txset import TxSetFrame
+        from stellar_tpu.xdr.base import xdr_to_opaque
+        from stellar_tpu.xdr.ledger import StellarValue
+
+        app = make_scp_app(clock, 31)
+        try:
+            herder = app.herder
+            lm = app.ledger_manager
+            lcl = lm.last_closed
+            root = T.root_key_for(app)
+            a1 = T.get_account("combine-a1")
+            candidates = set()
+
+            def add_to_candidates(txset, close_time):
+                txset.sort_for_hash()
+                herder.recv_tx_set(txset.get_contents_hash(), txset)
+                candidates.add(xdr_to_opaque(
+                    StellarValue(txset.get_contents_hash(), close_time, [], 0)
+                ))
+
+            def txs(n):
+                seq = root_seq(app)
+                return [
+                    T.tx_from_ops(app, root, seq + 1 + i,
+                                  [T.create_account_op(a1, 10**7)])
+                    for i in range(n)
+                ]
+
+            def combined():
+                return StellarValue.from_xdr(
+                    herder.combine_candidates(1, candidates)
+                )
+
+            txset0 = TxSetFrame(lcl.hash, [])
+            txset0.sort_for_hash()
+            add_to_candidates(txset0, 100)
+            sv = combined()
+            assert sv.closeTime == 100
+            assert sv.txSetHash == txset0.get_contents_hash()
+
+            txset1 = TxSetFrame(lcl.hash, txs(10))
+            add_to_candidates(txset1, 10)
+            sv = combined()
+            assert sv.closeTime == 100  # max close time, not txset1's 10
+            assert sv.txSetHash == txset1.get_contents_hash()  # biggest set
+
+            txset2 = TxSetFrame(lcl.hash, txs(5))
+            add_to_candidates(txset2, 1000)
+            sv = combined()
+            assert sv.closeTime == 1000  # new max close time...
+            assert sv.txSetHash == txset1.get_contents_hash()  # ...same set
+        finally:
+            app.database.close()

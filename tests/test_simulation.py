@@ -214,3 +214,79 @@ def test_full_mix_load_trust_offers():
     finally:
         app.graceful_stop()
         clock.shutdown()
+
+
+@pytest.mark.parametrize("force_scp", [True, False], ids=["force", "no-force"])
+def test_scp_state_across_restart(tmp_path, force_scp):
+    """HerderTests.cpp:563-700 "SCP State" / "Force SCP" / "No Force SCP":
+    two validators close one ledger on disk-backed DBs and stop.  A fresh
+    third node (never forcing SCP) waits at ledger 1.  The two restart from
+    their DBs and connect to it:
+
+    - FORCE_SCP: they restart SCP from their LCL — the network closes
+      ledger 3+, and any node at exactly 3 chains off the pre-restart LCL.
+    - no FORCE_SCP: they only rebroadcast their restored last statements —
+      node 2 externalizes ledger 2 from those, then everyone stays wedged
+      at the pre-restart LCL (nobody proposes)."""
+    from stellar_tpu.tx.testutils import get_test_config
+
+    keys = [SecretKey.pseudo_random_for_testing(700 + i) for i in range(3)]
+    ids = [k.get_public_key() for k in keys]
+    qset2 = SCPQuorumSet(2, [ids[0], ids[1]], [])
+
+    cfgs = []
+    for i in range(3):
+        cfg = get_test_config(40 + i)
+        cfg.DATABASE = f"sqlite3://{tmp_path}/node{i}.db"
+        cfgs.append(cfg)
+
+    sim = Simulation(OVER_LOOPBACK)
+    sim.add_node(keys[0], qset2, cfg=cfgs[0])
+    sim.add_node(keys[1], qset2, cfg=cfgs[1])
+    sim.add_pending_connection(ids[0], ids[1])
+    sim.start_all_nodes()
+    assert sim.crank_until(lambda: sim.have_all_externalized(2), 120)
+    lcl = sim.get_node(ids[0]).ledger_manager.last_closed
+    sim.stop_all_nodes()
+    for k in keys[:2]:
+        sim.get_node(k).database.close()
+    sim.clock.shutdown()
+
+    # restart simulation: fresh node 2 first, alone — it must sit at
+    # ledger 1 waiting for SCP traffic
+    qset_all = SCPQuorumSet(2, list(ids), [])
+    sim = Simulation(OVER_LOOPBACK)
+    sim.add_node(keys[2], qset_all, cfg=cfgs[2], force_scp=False)
+    sim.start_all_nodes()
+    sim.crank_for_at_least(1)
+    assert sim.get_node(ids[2]).ledger_manager.last_closed.header.ledgerSeq == 1
+
+    # nodes 0/1 come back from their DBs; their restored last statements
+    # flow to node 2 on connect
+    sim.add_node(keys[0], qset_all, cfg=cfgs[0], new_db=False,
+                 force_scp=force_scp)
+    sim.add_node(keys[1], qset_all, cfg=cfgs[1], new_db=False,
+                 force_scp=force_scp)
+    sim.get_node(ids[0]).start()
+    sim.get_node(ids[1]).start()
+    sim.add_connection(ids[0], ids[2])
+    sim.add_connection(ids[1], ids[2])
+
+    if force_scp:
+        assert sim.crank_until(lambda: sim.have_all_externalized(3), 120)
+        for i in range(3):
+            actual = sim.get_node(ids[i]).ledger_manager.last_closed.header
+            if actual.ledgerSeq == 3:
+                assert actual.previousLedgerHash == lcl.hash
+    else:
+        assert sim.crank_until(
+            lambda: sim.get_node(ids[2]).ledger_manager.last_closed.header.ledgerSeq
+            == 2,
+            30,
+        )
+        sim.crank_for_at_least(2)
+        for i in range(3):
+            actual = sim.get_node(ids[i]).ledger_manager.last_closed
+            assert actual.header.ledgerSeq == 2
+            assert actual.hash == lcl.hash, "stuck nodes must share the LCL"
+    sim.stop_all_nodes()

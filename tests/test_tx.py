@@ -70,6 +70,7 @@ class TestGenesis:
 
 class TestCreateAccount:
     def test_create_and_balance(self, app, root):
+        """PaymentTests.cpp:110-113 ("Create account" / "Success")."""
         dest = T.get_account(1)
         fund(app, root, dest, 5000 * 10**7)
         from stellar_tpu.ledger.accountframe import AccountFrame
@@ -80,6 +81,7 @@ class TestCreateAccount:
         assert acc.get_seq_num() == app.ledger_manager.current.header.ledgerSeq << 32
 
     def test_create_below_reserve_fails(self, app, root):
+        """PaymentTests.cpp:126-133 ("Amount too small to create account")."""
         dest = T.get_account(1)
         tx = T.tx_from_ops(
             app, root, root_seq(app, root) + 1, [T.create_account_op(dest, 1)]
@@ -91,6 +93,7 @@ class TestCreateAccount:
         )
 
     def test_create_duplicate_fails(self, app, root):
+        """PaymentTests.cpp:114-120 ("Account already exists")."""
         dest = T.get_account(1)
         fund(app, root, dest)
         tx = T.tx_from_ops(
@@ -103,9 +106,30 @@ class TestCreateAccount:
             == X.CreateAccountResultCode.CREATE_ACCOUNT_ALREADY_EXIST
         )
 
+    def test_create_underfunded_source_fails(self, app, root):
+        """PaymentTests.cpp:121-125 ("Not enough funds (source)") — a thin
+        source cannot fund a creation larger than its balance."""
+        from stellar_tpu.ledger.accountframe import AccountFrame
+
+        thin = fund(app, root, T.get_account(2), amount=60 * 10**7)
+        dest = T.get_account(3)
+        seq = AccountFrame.load_account(
+            thin.get_public_key(), app.database
+        ).get_seq_num()
+        tx = T.tx_from_ops(
+            app, thin, seq + 1, [T.create_account_op(dest, 10**12)]
+        )
+        T.apply_tx(app, tx, expect_code=RC.txFAILED)
+        assert (
+            T.inner_op_code(tx)
+            == X.CreateAccountResultCode.CREATE_ACCOUNT_UNDERFUNDED
+        )
+        assert AccountFrame.load_account(dest.get_public_key(), app.database) is None
+
 
 class TestPayment:
     def test_native_payment(self, app, root):
+        """PaymentTests.cpp:134-148 ("send XLM to an existing account")."""
         a = fund(app, root, T.get_account(1))
         b = fund(app, root, T.get_account(2))
         tx = T.tx_from_ops(app, a, (2 << 32) + 1, [T.payment_op(b, 10**7)])
@@ -123,6 +147,7 @@ class TestPayment:
         assert T.inner_op_code(tx) == X.PaymentResultCode.PAYMENT_UNDERFUNDED
 
     def test_payment_to_missing_account(self, app, root):
+        """PaymentTests.cpp:159-166 ("send XLM to a new account (no destination)")."""
         a = fund(app, root, T.get_account(1))
         ghost = T.get_account(99)
         tx = T.tx_from_ops(app, a, (2 << 32) + 1, [T.payment_op(ghost, 10**7)])
@@ -200,6 +225,7 @@ class TestMultisig:
 
 class TestTrustAndCredit:
     def test_trust_and_credit_payment(self, app, root):
+        """PaymentTests.cpp:236-267 ("with trust" / "positive")."""
         issuer = fund(app, root, T.get_account(1))
         holder = fund(app, root, T.get_account(2))
         usd = X.Asset.alphanum4(b"USD", issuer.get_public_key())
@@ -221,6 +247,8 @@ class TestTrustAndCredit:
         assert line.get_balance() == 500
 
     def test_payment_without_trust_fails(self, app, root):
+        """PaymentTests.cpp:223-235 ("credit sent to new account" /
+        "credit payment with no trust")."""
         issuer = fund(app, root, T.get_account(1))
         holder = fund(app, root, T.get_account(2))
         usd = X.Asset.alphanum4(b"USD", issuer.get_public_key())
@@ -355,6 +383,7 @@ class TestOffersAndPathPayment:
 
 class TestMerge:
     def test_merge_moves_balance(self, app, root):
+        """MergeTests.cpp:119-126 ("success - basic")."""
         a = fund(app, root, T.get_account(1), 1000 * 10**7)
         b = fund(app, root, T.get_account(2))
         from stellar_tpu.ledger.accountframe import AccountFrame
@@ -368,6 +397,7 @@ class TestMerge:
         assert b_acc.get_balance() == 10_000 * 10**7 + a_bal - 100  # minus fee
 
     def test_merge_with_trustline_fails(self, app, root):
+        """MergeTests.cpp:85-94 ("With sub entries" / "account has trust line")."""
         issuer = fund(app, root, T.get_account(1))
         a = fund(app, root, T.get_account(2))
         usd = X.Asset.alphanum4(b"USD", issuer.get_public_key())

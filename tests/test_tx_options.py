@@ -153,6 +153,7 @@ class TestSetOptionsFlags:
         "domain", ["abc\r", "abc\x7f", "ab\x00c"]
     )
     def test_invalid_home_domain(self, app, root, a1, domain):
+        """SetOptionsTests.cpp:178-188 ("Home domain" / "invalid home domain")."""
         tx = apply_one(app, a1, T.set_options_op(home_domain=domain),
                        expect=RC.txFAILED)
         assert T.inner_op_code(tx) == SOC.SET_OPTIONS_INVALID_HOME_DOMAIN
@@ -169,17 +170,20 @@ class TestAccountMerge:
         return a1, min_balance
 
     def test_merge_into_self_malformed(self, app, root, world):
+        """MergeTests.cpp:58-62 ("merge into self")."""
         a1, _ = world
         tx = apply_one(app, a1, T.merge_op(a1), expect=RC.txFAILED)
         assert T.inner_op_code(tx) == AMC.ACCOUNT_MERGE_MALFORMED
 
     def test_merge_into_ghost_no_account(self, app, root, world):
+        """MergeTests.cpp:63-75 ("merge into non existent account")."""
         a1, _ = world
         tx = apply_one(app, a1, T.merge_op(T.get_account(2)),
                        expect=RC.txFAILED)
         assert T.inner_op_code(tx) == AMC.ACCOUNT_MERGE_NO_ACCOUNT
 
     def test_merge_immutable_rejected(self, app, root, world):
+        """MergeTests.cpp:76-84 ("Account has static auth flag set")."""
         a1, min_balance = world
         b1 = fund(app, root, T.get_account(2), min_balance)
         apply_one(app, a1, T.set_options_op(

@@ -248,6 +248,7 @@ class TestPathPayment:
         assert T.inner_op_code(tx) == PPC.PATH_PAYMENT_TOO_FEW_OFFERS
 
     def test_over_sendmax(self, app, root, path_world):
+        """PaymentTests.cpp:389-398 ("send with path (over sendmax)")."""
         gw, gw2, a1, b1, c1, idr, usd, ob, oc = path_world
         tx = apply_one(
             app, a1, T.path_payment_op(b1, usd, 149 * M, idr, 100 * M),
