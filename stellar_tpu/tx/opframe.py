@@ -11,7 +11,7 @@ from __future__ import annotations
 from typing import Optional
 
 from ..ledger.accountframe import AccountFrame
-from ..xdr.entries import PublicKey
+from ..xdr.entries import AssetType, PublicKey
 from ..xdr.txs import (
     Operation,
     OperationResult,
@@ -28,8 +28,6 @@ _ALNUM = set(
 
 def is_asset_valid(asset) -> bool:
     """util/types.cpp isAssetValid: [a-zA-Z0-9]+ then zero padding only."""
-    from ..xdr.entries import AssetType
-
     if asset.type == AssetType.ASSET_TYPE_NATIVE:
         return True
     code = asset.value.assetCode
@@ -64,31 +62,38 @@ class OperationFrame:
         self.source_account: Optional[AccountFrame] = None
 
     # -- factory (OperationFrame::makeHelper) ------------------------------
+    # built lazily ONCE: the op modules import this one, so the mapping
+    # can't exist at module load — but rebuilding it (and re-executing ten
+    # imports) per op was measurable at 5000-tx closes
+    _HELPER_MAP = None
+
     @staticmethod
     def make_helper(op: Operation, result: OperationResult, parent_tx):
-        from .ops_account import (
-            AllowTrustOpFrame,
-            ChangeTrustOpFrame,
-            CreateAccountOpFrame,
-            InflationOpFrame,
-            MergeOpFrame,
-            SetOptionsOpFrame,
-        )
-        from .ops_offers import CreatePassiveOfferOpFrame, ManageOfferOpFrame
-        from .ops_payment import PathPaymentOpFrame, PaymentOpFrame
+        mapping = OperationFrame._HELPER_MAP
+        if mapping is None:
+            from .ops_account import (
+                AllowTrustOpFrame,
+                ChangeTrustOpFrame,
+                CreateAccountOpFrame,
+                InflationOpFrame,
+                MergeOpFrame,
+                SetOptionsOpFrame,
+            )
+            from .ops_offers import CreatePassiveOfferOpFrame, ManageOfferOpFrame
+            from .ops_payment import PathPaymentOpFrame, PaymentOpFrame
 
-        mapping = {
-            OperationType.CREATE_ACCOUNT: CreateAccountOpFrame,
-            OperationType.PAYMENT: PaymentOpFrame,
-            OperationType.PATH_PAYMENT: PathPaymentOpFrame,
-            OperationType.MANAGE_OFFER: ManageOfferOpFrame,
-            OperationType.CREATE_PASSIVE_OFFER: CreatePassiveOfferOpFrame,
-            OperationType.SET_OPTIONS: SetOptionsOpFrame,
-            OperationType.CHANGE_TRUST: ChangeTrustOpFrame,
-            OperationType.ALLOW_TRUST: AllowTrustOpFrame,
-            OperationType.ACCOUNT_MERGE: MergeOpFrame,
-            OperationType.INFLATION: InflationOpFrame,
-        }
+            mapping = OperationFrame._HELPER_MAP = {
+                OperationType.CREATE_ACCOUNT: CreateAccountOpFrame,
+                OperationType.PAYMENT: PaymentOpFrame,
+                OperationType.PATH_PAYMENT: PathPaymentOpFrame,
+                OperationType.MANAGE_OFFER: ManageOfferOpFrame,
+                OperationType.CREATE_PASSIVE_OFFER: CreatePassiveOfferOpFrame,
+                OperationType.SET_OPTIONS: SetOptionsOpFrame,
+                OperationType.CHANGE_TRUST: ChangeTrustOpFrame,
+                OperationType.ALLOW_TRUST: AllowTrustOpFrame,
+                OperationType.ACCOUNT_MERGE: MergeOpFrame,
+                OperationType.INFLATION: InflationOpFrame,
+            }
         cls = mapping.get(op.body.type)
         if cls is None:
             raise ValueError(f"Unknown op type {op.body.type!r}")
