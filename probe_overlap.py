@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Relay upload/execute overlap probe (developer tool, PROFILE.md round-5
+checklist #3).
+
+The round-3 transfer addendum measured the relay serializing a batch's
+upload (128 B/item at 36-42 MB/s) with its execution, capping end-to-end
+verify throughput at ~130-155k/s even though the kernel's marginal rate is
+~440k/s.  Open question: is that serialization per-CONNECTION (two
+concurrent dispatch streams would overlap one batch's upload with
+another's execute, raising the ceiling) or global in the relay?
+
+This probe answers it in one run:
+  1. serial: k dispatches of fresh host arrays (upload + execute), timed
+  2. pipelined: the same 2k half-batches from 2 threads
+
+If pipelined verifies/s meaningfully exceeds serial (>15%), wire bench.py
+to dispatch from two streams; if not, the ceiling is the relay's and the
+in-repo levers are exhausted (PROFILE.md transfer addendum stands).
+
+Usage: python probe_overlap.py [batch] [rounds]   # needs the TPU relay
+"""
+
+import sys
+import threading
+import time
+
+import numpy as np
+
+
+def _staged_inputs(bv, batch, seed):
+    from stellar_tpu.crypto import SecretKey
+
+    items = []
+    for i in range(batch):
+        sk = SecretKey.pseudo_random_for_testing(seed * 1_000_000 + i)
+        msg = b"overlap probe %08d/%02d" % (i, seed)
+        items.append((i, sk.public_raw, msg, sk.sign(msg)))
+    return tuple(np.ascontiguousarray(c.T) for c in bv._stage_chunk(items))
+
+
+def main(batch=32768, rounds=6):
+    import jax
+    import jax.numpy as jnp
+
+    from stellar_tpu.ops.ed25519 import BatchVerifier
+    from stellar_tpu.ops.ed25519_pallas import verify_kernel_pallas
+
+    assert jax.default_backend() == "tpu", (
+        f"needs the TPU (have {jax.default_backend()}); "
+        "do not force JAX_PLATFORMS=cpu"
+    )
+    bv = BatchVerifier(max_batch=batch, backend="pallas")
+
+    # distinct host buffers per round so every dispatch really uploads
+    hosts = [_staged_inputs(bv, batch, s) for s in range(rounds)]
+
+    def dispatch(host):
+        arrs = [jnp.asarray(c) for c in host]  # upload
+        ok = verify_kernel_pallas(*arrs)  # execute
+        ok.block_until_ready()
+        return bool(np.asarray(ok).all())
+
+    assert dispatch(hosts[0]), "probe signatures must verify"  # compile+check
+
+    t0 = time.perf_counter()
+    for h in hosts:
+        assert dispatch(h)
+    serial = time.perf_counter() - t0
+    serial_rate = rounds * batch / serial
+
+    results = [None, None]
+
+    def worker(tid):
+        for h in hosts[tid::2]:
+            assert dispatch(h)
+        results[tid] = True
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=worker, args=(t,)) for t in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    piped = time.perf_counter() - t0
+    piped_rate = rounds * batch / piped
+    assert all(results)
+
+    gain = piped_rate / serial_rate - 1.0
+    print(
+        f"serial: {serial_rate:,.0f} verifies/s ({serial:.2f}s for "
+        f"{rounds}x{batch}); 2-thread pipelined: {piped_rate:,.0f} "
+        f"verifies/s ({piped:.2f}s); overlap gain {gain:+.1%}"
+    )
+    print(
+        "verdict: "
+        + (
+            "relay overlaps streams — wire bench.py for 2-stream dispatch"
+            if gain > 0.15
+            else "relay serializes globally — e2e ceiling stands"
+        )
+    )
+
+
+if __name__ == "__main__":
+    main(*(int(a) for a in sys.argv[1:]))
