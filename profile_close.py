@@ -4,7 +4,9 @@
 Not part of the test suite — a developer tool for attacking the
 ledger-close p50 (BASELINE.md second headline metric).  Usage:
 
-    python profile_close.py [n_txs] [n_ledgers]
+    python profile_close.py [n_txs] [n_ledgers]          # cProfile a close
+    python profile_close.py ladder [scale...] [--no-buffer]
+    python profile_close.py ab [n_txs] [n_ledgers]       # buffer A/B
 """
 
 import cProfile
@@ -15,103 +17,134 @@ import sys
 import time
 
 
-def main(n_txs=1000, n_ledgers=3):
-    from stellar_tpu.herder.ledgerclose import LedgerCloseData
-    from stellar_tpu.herder.txset import TxSetFrame
-    from stellar_tpu.ledger.accountframe import AccountFrame
+# -- shared close-drive scaffold (used by main, ladder, and ab) -------------
+
+
+def _make_app(instance, n_txs, buffered=True):
     from stellar_tpu.main.application import Application
     from stellar_tpu.tx import testutils as T
     from stellar_tpu.util.clock import VirtualClock
+
+    cfg = T.get_test_config(instance, backend="cpu")
+    cfg.DESIRED_MAX_TX_PER_LEDGER = n_txs * 2
+    cfg.ENTRY_WRITE_BUFFER = buffered
+    clock = VirtualClock()
+    return Application.create(clock, cfg, new_db=True), clock
+
+
+def _max_txset_upgrade(n_txs):
     from stellar_tpu.xdr.base import xdr_to_opaque
-    from stellar_tpu.xdr.ledger import (
-        LedgerUpgrade,
-        LedgerUpgradeType,
-        StellarValue,
+    from stellar_tpu.xdr.ledger import LedgerUpgrade, LedgerUpgradeType
+
+    return xdr_to_opaque(
+        LedgerUpgrade(LedgerUpgradeType.LEDGER_UPGRADE_MAX_TX_SET_SIZE, n_txs * 2)
     )
 
-    cfg = T.get_test_config(96, backend="cpu")
-    cfg.DESIRED_MAX_TX_PER_LEDGER = n_txs * 2
-    clock = VirtualClock()
-    app = Application.create(clock, cfg, new_db=True)
-    try:
-        lm = app.ledger_manager
-        root = T.root_key_for(app)
-        up = xdr_to_opaque(
-            LedgerUpgrade(
-                LedgerUpgradeType.LEDGER_UPGRADE_MAX_TX_SET_SIZE, n_txs * 2
+
+def _drive_close(app, txs, upgrades=()):
+    """sort_for_hash + check_valid + close_ledger for one txset.
+
+    Returns (total_s, close_s): total includes check_valid (what a node
+    pays end-to-end), close_s is close_ledger alone (the PROFILE.md A/B
+    metric)."""
+    from stellar_tpu.herder.ledgerclose import LedgerCloseData
+    from stellar_tpu.herder.txset import TxSetFrame
+    from stellar_tpu.xdr.ledger import StellarValue
+
+    lm = app.ledger_manager
+    txset = TxSetFrame(lm.last_closed.hash, list(txs))
+    txset.sort_for_hash()
+    t0 = time.perf_counter()
+    ok = txset.check_valid(app)
+    sv = StellarValue(
+        txset.get_contents_hash(),
+        lm.last_closed.header.scpValue.closeTime + 5,
+        list(upgrades),
+        0,
+    )
+    t1 = time.perf_counter()
+    lm.close_ledger(LedgerCloseData(lm.current.header.ledgerSeq, txset, sv))
+    t2 = time.perf_counter()
+    assert ok
+    return t2 - t0, t2 - t1
+
+
+def _populate(app, accounts, n_txs):
+    """Create `accounts` through real closes (100-op create txs, 2000 per
+    close), applying the max-txset upgrade on the first close.  Returns
+    {strkey: creation ledger seq} for payment seq-num math."""
+    from stellar_tpu.ledger.accountframe import AccountFrame
+    from stellar_tpu.tx import testutils as T
+
+    lm = app.ledger_manager
+    root = T.root_key_for(app)
+    seq = AccountFrame.load_account(
+        root.get_public_key(), app.database
+    ).get_seq_num()
+    upgrades = [_max_txset_upgrade(n_txs)]
+    created_at = {}
+    for start in range(0, len(accounts), 2000):
+        batch = accounts[start : start + 2000]
+        txs = []
+        for i in range(0, len(batch), 100):
+            seq += 1
+            txs.append(
+                T.tx_from_ops(
+                    app, root, seq,
+                    [T.create_account_op(a, 10**10) for a in batch[i : i + 100]],
+                )
             )
+        _drive_close(app, txs, upgrades)
+        upgrades = []
+        for a in batch:
+            created_at[a.get_strkey_public()] = lm.last_closed.header.ledgerSeq
+    return created_at
+
+
+def _payment_txs(app, accounts, created_at, n_txs, round_no, dest_of=None):
+    """One payment tx per source account; `dest_of(i)` returns the dest
+    PublicKey (defaults to the next account in the list)."""
+    from stellar_tpu.tx import testutils as T
+    import stellar_tpu.xdr as X
+
+    txs = []
+    for i in range(n_txs):
+        src = accounts[i]
+        dest_pk = (
+            dest_of(i) if dest_of is not None
+            else accounts[i + 1].get_public_key()
         )
-        upgrades = [up]
+        s = (created_at[src.get_strkey_public()] << 32) + 1 + round_no
+        op = T.op(
+            X.OperationType.PAYMENT,
+            X.PaymentOp(dest_pk, X.Asset.native(), 1000),
+        )
+        txs.append(T.tx_from_ops(app, src, s, [op]))
+    return txs
+
+
+# -- modes ------------------------------------------------------------------
+
+
+def main(n_txs=1000, n_ledgers=3):
+    from stellar_tpu.tx import testutils as T
+
+    app, clock = _make_app(96, n_txs)
+    try:
         accounts = [T.get_account(i + 1) for i in range(n_txs + 1)]
-        seq = AccountFrame.load_account(
-            root.get_public_key(), app.database
-        ).get_seq_num()
-        created_at = {}
-        for start in range(0, len(accounts), 2000):
-            batch = accounts[start : start + 2000]
-            txs = []
-            for i in range(0, len(batch), 100):
-                seq += 1
-                txs.append(
-                    T.tx_from_ops(
-                        app,
-                        root,
-                        seq,
-                        [
-                            T.create_account_op(a, 10**10)
-                            for a in batch[i : i + 100]
-                        ],
-                    )
-                )
-            txset = TxSetFrame(lm.last_closed.hash, txs)
-            txset.sort_for_hash()
-            assert txset.check_valid(app)
-            sv = StellarValue(
-                txset.get_contents_hash(),
-                lm.last_closed.header.scpValue.closeTime + 5,
-                upgrades,
-                0,
-            )
-            upgrades = []
-            lm.close_ledger(
-                LedgerCloseData(lm.current.header.ledgerSeq, txset, sv)
-            )
-            for a in batch:
-                created_at[a.get_strkey_public()] = (
-                    lm.last_closed.header.ledgerSeq
-                )
+        created_at = _populate(app, accounts, n_txs)
 
         pr = cProfile.Profile()
         times = []
         for j in range(n_ledgers):
-            txs = []
-            for i in range(n_txs):
-                src = accounts[i]
-                dst = accounts[i + 1]
-                s = (created_at[src.get_strkey_public()] << 32) + 1 + j
-                txs.append(
-                    T.tx_from_ops(app, src, s, [T.payment_op(dst, 1000)])
-                )
-            txset = TxSetFrame(lm.last_closed.hash, txs)
-            txset.sort_for_hash()
-            t0 = time.perf_counter()
+            txs = _payment_txs(app, accounts, created_at, n_txs, j)
             pr.enable()
-            ok = txset.check_valid(app)
-            sv = StellarValue(
-                txset.get_contents_hash(),
-                lm.last_closed.header.scpValue.closeTime + 5,
-                [],
-                0,
-            )
-            lm.close_ledger(
-                LedgerCloseData(lm.current.header.ledgerSeq, txset, sv)
-            )
+            total_s, _close_s = _drive_close(app, txs)
             pr.disable()
-            times.append(time.perf_counter() - t0)
-            assert ok
+            times.append(total_s)
         print(
             f"p50 {statistics.median(times) * 1e3:.0f} ms over {n_ledgers} "
-            f"closes of {n_txs} txs"
+            f"closes of {n_txs} txs (incl check_valid; profiler overhead incl.)"
         )
         for sort in ("cumulative", "tottime"):
             s = io.StringIO()
@@ -124,7 +157,8 @@ def main(n_txs=1000, n_ledgers=3):
         clock.shutdown()
 
 
-def ladder(scales=(10**4, 10**5, 10**6), n_txs=5000, n_ledgers=3):
+def ladder(scales=(10**4, 10**5, 10**6), n_txs=5000, n_ledgers=3,
+           buffered=True):
     """Account-scale close ladder (reference shape:
     LedgerPerformanceTests.cpp:149-225 — pre-create accounts, time the
     close loop at each scale).
@@ -139,71 +173,18 @@ def ladder(scales=(10**4, 10**5, 10**6), n_txs=5000, n_ledgers=3):
     import random
 
     from stellar_tpu.crypto import strkey
-    from stellar_tpu.herder.ledgerclose import LedgerCloseData
-    from stellar_tpu.herder.txset import TxSetFrame
-    from stellar_tpu.ledger.accountframe import AccountFrame
     from stellar_tpu.ledger.entryframe import entry_cache_of
-    from stellar_tpu.main.application import Application
     from stellar_tpu.tx import testutils as T
-    from stellar_tpu.util.clock import VirtualClock
-    from stellar_tpu.xdr.base import xdr_to_opaque
-    from stellar_tpu.xdr.ledger import (
-        LedgerUpgrade,
-        LedgerUpgradeType,
-        StellarValue,
-    )
+    from stellar_tpu.xdr.xtypes import PublicKey
 
     thresholds_b64 = base64.b64encode(b"\x01\x00\x00\x00").decode()
     results = []
     for scale in scales:
-        cfg = T.get_test_config(95, backend="cpu")
-        cfg.DESIRED_MAX_TX_PER_LEDGER = n_txs * 2
-        clock = VirtualClock()
-        app = Application.create(clock, cfg, new_db=True)
+        app, clock = _make_app(95, n_txs, buffered=buffered)
         try:
-            lm = app.ledger_manager
-            root = T.root_key_for(app)
-            up = xdr_to_opaque(
-                LedgerUpgrade(
-                    LedgerUpgradeType.LEDGER_UPGRADE_MAX_TX_SET_SIZE,
-                    n_txs * 2,
-                )
-            )
-            # real-keyed payment sources, created through actual closes
             srcs = [T.get_account(i + 1) for i in range(n_txs + 1)]
-            seq = AccountFrame.load_account(
-                root.get_public_key(), app.database
-            ).get_seq_num()
-            upgrades = [up]
-            created_at = {}
-            for start in range(0, len(srcs), 2000):
-                batch = srcs[start : start + 2000]
-                txs = []
-                for i in range(0, len(batch), 100):
-                    seq += 1
-                    txs.append(
-                        T.tx_from_ops(
-                            app, root, seq,
-                            [T.create_account_op(a, 10**10)
-                             for a in batch[i : i + 100]],
-                        )
-                    )
-                txset = TxSetFrame(lm.last_closed.hash, txs)
-                txset.sort_for_hash()
-                assert txset.check_valid(app)
-                sv = StellarValue(
-                    txset.get_contents_hash(),
-                    lm.last_closed.header.scpValue.closeTime + 5,
-                    upgrades, 0,
-                )
-                upgrades = []
-                lm.close_ledger(
-                    LedgerCloseData(lm.current.header.ledgerSeq, txset, sv)
-                )
-                for a in batch:
-                    created_at[a.get_strkey_public()] = (
-                        lm.last_closed.header.ledgerSeq
-                    )
+            created_at = _populate(app, srcs, n_txs)
+
             # synthetic bulk rows straight into the accounts table
             n_synth = max(0, scale - len(srcs))
             t0 = time.perf_counter()
@@ -224,48 +205,22 @@ def ladder(scales=(10**4, 10**5, 10**6), n_txs=5000, n_ledgers=3):
                     rows,
                 )
             populate_s = time.perf_counter() - t0
-            synth_ids = [r[0] for r in rows]
+            synth_pks = [
+                PublicKey.from_ed25519(strkey.from_account_strkey(r[0]))
+                for r in rows
+            ]
 
             rng = random.Random(42)
             cache = entry_cache_of(app.database)
             times = []
             cache.hits = cache.misses = 0
+            dest_of = (
+                (lambda i: rng.choice(synth_pks)) if synth_pks else None
+            )
             for j in range(n_ledgers):
-                txs = []
-                for i in range(n_txs):
-                    src = srcs[i]
-                    if synth_ids:
-                        dest_sk = None
-                        dest_id = rng.choice(synth_ids)
-                    else:
-                        dest_id = srcs[i + 1].get_strkey_public()
-                    s = (created_at[src.get_strkey_public()] << 32) + 1 + j
-                    from stellar_tpu.xdr.xtypes import PublicKey
-
-                    dest_pk = PublicKey.from_ed25519(
-                        strkey.from_account_strkey(dest_id)
-                    )
-                    op = T.op(
-                        T.X.OperationType.PAYMENT,
-                        T.X.PaymentOp(
-                            dest_pk, T.X.Asset.native(), 1000
-                        ),
-                    )
-                    txs.append(T.tx_from_ops(app, src, s, [op]))
-                txset = TxSetFrame(lm.last_closed.hash, txs)
-                txset.sort_for_hash()
-                t0 = time.perf_counter()
-                ok = txset.check_valid(app)
-                sv = StellarValue(
-                    txset.get_contents_hash(),
-                    lm.last_closed.header.scpValue.closeTime + 5,
-                    [], 0,
-                )
-                lm.close_ledger(
-                    LedgerCloseData(lm.current.header.ledgerSeq, txset, sv)
-                )
-                times.append(time.perf_counter() - t0)
-                assert ok
+                txs = _payment_txs(app, srcs, created_at, n_txs, j, dest_of)
+                total_s, _close_s = _drive_close(app, txs)
+                times.append(total_s)
             hit_rate = cache.hits / max(1, cache.hits + cache.misses)
             p50 = statistics.median(times)
             results.append((scale, p50, hit_rate, populate_s))
@@ -281,16 +236,58 @@ def ladder(scales=(10**4, 10**5, 10**6), n_txs=5000, n_ledgers=3):
     return results
 
 
+def ab(n_txs=5000, n_ledgers=5):
+    """ENTRY_WRITE_BUFFER A/B: identical payment closes with the store
+    buffer on vs off; prints both close-only p50s and asserts the final
+    ledger hashes match (the PROFILE.md round-5 table's methodology).
+    Pair samples within one window — this host's speed drifts (see
+    PROFILE.md round-5 caveat)."""
+    from stellar_tpu.tx import testutils as T
+
+    def run(buffered):
+        app, clock = _make_app(97 if buffered else 98, n_txs,
+                               buffered=buffered)
+        try:
+            accounts = [T.get_account(i + 1) for i in range(n_txs + 1)]
+            created_at = _populate(app, accounts, n_txs)
+            times = []
+            for j in range(n_ledgers):
+                txs = _payment_txs(app, accounts, created_at, n_txs, j)
+                _total_s, close_s = _drive_close(app, txs)
+                times.append(close_s)
+            return statistics.median(times), app.ledger_manager.last_closed.hash
+        finally:
+            app.graceful_stop()
+            clock.shutdown()
+
+    p50_on, h_on = run(True)
+    p50_off, h_off = run(False)
+    print(
+        f"ENTRY_WRITE_BUFFER on:  close p50 {p50_on * 1e3:.0f} ms\n"
+        f"ENTRY_WRITE_BUFFER off: close p50 {p50_off * 1e3:.0f} ms"
+    )
+    assert h_on == h_off, "ledger hash diverged between write modes!"
+    print("final ledger hashes match")
+
+
 if __name__ == "__main__":
-    if len(sys.argv) > 1 and sys.argv[1] == "ladder":
+    args = sys.argv[1:]
+    if args and args[0] == "ladder":
+        buffered = "--no-buffer" not in args
+        scales_args = [a for a in args[1:] if a != "--no-buffer"]
         scales = (
-            tuple(int(s) for s in sys.argv[2:])
-            if len(sys.argv) > 2
+            tuple(int(s) for s in scales_args)
+            if scales_args
             else (10**4, 10**5, 10**6)
         )
-        ladder(scales)
+        ladder(scales, buffered=buffered)
+    elif args and args[0] == "ab":
+        ab(
+            int(args[1]) if len(args) > 1 else 5000,
+            int(args[2]) if len(args) > 2 else 5,
+        )
     else:
         main(
-            int(sys.argv[1]) if len(sys.argv) > 1 else 1000,
-            int(sys.argv[2]) if len(sys.argv) > 2 else 3,
+            int(args[0]) if args else 1000,
+            int(args[1]) if len(args) > 1 else 3,
         )
