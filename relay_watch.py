@@ -1,0 +1,178 @@
+#!/usr/bin/env python
+"""Relay-window watcher: run the round-5 measurement checklist when alive.
+
+The axon relay's availability comes in windows (observed r04/r05: minutes
+of life between multi-hour outages; a window this round lasted just long
+enough for bench.py and died before profile_kernel.py finished).  This
+watcher probes the relay in killable subprocesses (same pattern as
+bench._probe_tpu_alive) and, the moment a probe answers, runs the pending
+checklist steps in priority order — each in its own killable child with a
+step timeout, so a mid-step relay death costs that step, not the watcher.
+Steps that fail are retried in the next window.  State persists in
+STATE_PATH so a watcher restart resumes where it left off.
+
+Usage: python relay_watch.py [--once]   # nohup it; tail LOG_PATH
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+STATE_PATH = "/tmp/relay_watch_state.json"
+LOG_PATH = "/tmp/relay_watch.log"
+ACTIVE_FLAG = "/tmp/relay_window_active"  # advisory: a step is running
+
+# (name, argv, timeout_s).  Priority order: the unmeasured round-4 kernel
+# optimization first (VERDICT r04 next #2), then the overlap question
+# (PROFILE round-5 checklist #3), then tpu-side close sizes (#3 of the
+# checklist; the cpu legs run locally right after, same host window).
+_CLOSE_CHILD = (
+    "import json, bench\n"
+    "r = bench.bench_ledger_close(n_txs={n}, n_ledgers=3)\n"
+    "print('RESULT ' + json.dumps(r), flush=True)\n"
+)
+STEPS = [
+    ("kernel", [sys.executable, "-u", "profile_kernel.py"], 900),
+    ("overlap", [sys.executable, "-u", "probe_overlap.py"], 700),
+    (
+        "close_tpu_500",
+        [sys.executable, "-u", "-c", _CLOSE_CHILD.format(n=500)],
+        420,
+    ),
+    (
+        "close_tpu_5000",
+        [sys.executable, "-u", "-c", _CLOSE_CHILD.format(n=5000)],
+        900,
+    ),
+]
+# cpu legs paired with each tpu close (run immediately after, no relay
+# needed — same-window pairing controls for host speed drift)
+CPU_AFTER = {
+    "close_tpu_500": ("close_cpu_500", 500, 420),
+    "close_tpu_5000": ("close_cpu_5000", 5000, 900),
+}
+
+
+def log(msg):
+    line = "[%s] %s" % (time.strftime("%H:%M:%S"), msg)
+    with open(LOG_PATH, "a") as f:
+        f.write(line + "\n")
+    print(line, flush=True)
+
+
+def load_state():
+    try:
+        with open(STATE_PATH) as f:
+            return json.load(f)
+    except Exception:
+        return {"done": {}, "attempts": {}}
+
+
+def save_state(st):
+    with open(STATE_PATH, "w") as f:
+        json.dump(st, f, indent=1)
+
+
+def probe_alive(timeout=90.0):
+    code = "import jax\nassert jax.devices()\nprint('ok')\n"
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", code],
+            timeout=timeout,
+            capture_output=True,
+            text=True,
+        )
+        return p.returncode == 0 and "ok" in p.stdout
+    except Exception:
+        return False
+
+
+def run_step(name, argv, timeout, env=None):
+    log("step %s starting (timeout %ds)" % (name, timeout))
+    t0 = time.monotonic()
+    full_env = dict(os.environ)
+    if env:
+        full_env.update(env)
+    try:
+        p = subprocess.run(
+            argv,
+            cwd=REPO,
+            timeout=timeout,
+            capture_output=True,
+            text=True,
+            env=full_env,
+        )
+    except subprocess.TimeoutExpired:
+        log("step %s KILLED after %ds (relay died mid-step?)" % (name, timeout))
+        return None
+    dt = time.monotonic() - t0
+    out = (p.stdout or "") + ("\n--- stderr ---\n" + p.stderr if p.stderr else "")
+    with open("/tmp/relay_step_%s.log" % name, "w") as f:
+        f.write(out)
+    if p.returncode != 0:
+        log(
+            "step %s FAILED rc=%d in %.0fs (tail: %s)"
+            % (name, p.returncode, dt, (p.stderr or p.stdout or "").strip()[-200:])
+        )
+        return None
+    log("step %s OK in %.0fs" % (name, dt))
+    return p.stdout
+
+
+def run_cpu_close(name, n_txs, timeout):
+    code = (
+        "import jax\njax.config.update('jax_platforms', 'cpu')\n"
+        + _CLOSE_CHILD.format(n=n_txs)
+    )
+    return run_step(name, [sys.executable, "-u", "-c", code], timeout)
+
+
+def main():
+    once = "--once" in sys.argv
+    st = load_state()
+    pending = [s for s in STEPS if s[0] not in st["done"]]
+    log("watcher up; pending: %s" % [s[0] for s in pending])
+    while pending:
+        if not probe_alive():
+            log("relay dead; sleeping 60s")
+            if once:
+                return 1
+            time.sleep(60)
+            continue
+        log("RELAY ALIVE — running pending steps")
+        open(ACTIVE_FLAG, "w").write(str(os.getpid()))
+        try:
+            for name, argv, timeout in list(pending):
+                st["attempts"][name] = st["attempts"].get(name, 0) + 1
+                out = run_step(name, argv, timeout)
+                if out is not None:
+                    st["done"][name] = out.strip()[-2000:]
+                    save_state(st)
+                    if name in CPU_AFTER:
+                        cname, n, ct = CPU_AFTER[name]
+                        cout = run_cpu_close(cname, n, ct)
+                        if cout is not None:
+                            st["done"][cname] = cout.strip()[-2000:]
+                            save_state(st)
+                else:
+                    save_state(st)
+                    break  # re-probe before burning the next step's budget
+        finally:
+            try:
+                os.unlink(ACTIVE_FLAG)
+            except OSError:
+                pass
+        pending = [s for s in STEPS if s[0] not in st["done"]]
+        if pending and not once:
+            time.sleep(20)
+        elif once:
+            break
+    log("all steps done" if not pending else "exiting with pending steps")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
