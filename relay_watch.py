@@ -4,12 +4,12 @@
 The axon relay's availability comes in windows (observed r04/r05: minutes
 of life between multi-hour outages; a window this round lasted just long
 enough for bench.py and died before profile_kernel.py finished).  This
-watcher probes the relay in killable subprocesses (same pattern as
-bench._probe_tpu_alive) and, the moment a probe answers, runs the pending
-checklist steps in priority order — each in its own killable child with a
-step timeout, so a mid-step relay death costs that step, not the watcher.
-Steps that fail are retried in the next window.  State persists in
-STATE_PATH so a watcher restart resumes where it left off.
+watcher probes the relay with bench._probe_tpu_alive (killable children)
+and, the moment a probe answers, runs the pending checklist steps in
+priority order — each in its own killable child with a step timeout, so a
+mid-step relay death costs that step, not the watcher.  Steps that fail
+are retried in the next window, including the paired same-window CPU
+close legs.  State persists in STATE_PATH so a restart resumes.
 
 Usage: python relay_watch.py [--once]   # nohup it; tail LOG_PATH
 """
@@ -21,39 +21,40 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+import bench  # noqa: E402  (probe + close-child helpers live there)
+
 STATE_PATH = "/tmp/relay_watch_state.json"
 LOG_PATH = "/tmp/relay_watch.log"
 ACTIVE_FLAG = "/tmp/relay_window_active"  # advisory: a step is running
 
-# (name, argv, timeout_s).  Priority order: the unmeasured round-4 kernel
-# optimization first (VERDICT r04 next #2), then the overlap question
-# (PROFILE round-5 checklist #3), then tpu-side close sizes (#3 of the
-# checklist; the cpu legs run locally right after, same host window).
-_CLOSE_CHILD = (
-    "import json, bench\n"
-    "r = bench.bench_ledger_close(n_txs={n}, n_ledgers=3)\n"
-    "print('RESULT ' + json.dumps(r), flush=True)\n"
-)
-STEPS = [
+# Priority order: the unmeasured round-4 kernel optimization first
+# (VERDICT r04 next #2), then the overlap question (PROFILE round-5
+# checklist #3), then tpu-side close sizes (#2).  Each tpu close is
+# paired with a CPU leg run immediately after (no relay needed — the
+# same-window pairing controls for host speed drift) that is itself a
+# first-class pending step, so a failed CPU leg retries next window.
+SCRIPT_STEPS = [
     ("kernel", [sys.executable, "-u", "profile_kernel.py"], 900),
     ("overlap", [sys.executable, "-u", "probe_overlap.py"], 700),
-    (
-        "close_tpu_500",
-        [sys.executable, "-u", "-c", _CLOSE_CHILD.format(n=500)],
-        420,
-    ),
-    (
-        "close_tpu_5000",
-        [sys.executable, "-u", "-c", _CLOSE_CHILD.format(n=5000)],
-        900,
-    ),
 ]
-# cpu legs paired with each tpu close (run immediately after, no relay
-# needed — same-window pairing controls for host speed drift)
-CPU_AFTER = {
-    "close_tpu_500": ("close_cpu_500", 500, 420),
-    "close_tpu_5000": ("close_cpu_5000", 5000, 900),
+CLOSE_STEPS = [
+    # (name, n_txs, backend, timeout); cpu legs listed after their pair
+    ("close_tpu_500", 500, "tpu", 420),
+    ("close_cpu_500", 500, "cpu", 420),
+    ("close_tpu_5000", 5000, "tpu", 900),
+    ("close_cpu_5000", 5000, "cpu", 900),
+]
+# A cpu leg only runs once its tpu pair has succeeded — PROFILE.md:
+# host speed swings 1.4x between windows, so an unpaired cpu sample is
+# worse than none.  (CPU legs are local-only and effectively never fail,
+# so in practice the pair lands back-to-back in one window.)
+PAIR_GATE = {
+    "close_cpu_500": "close_tpu_500",
+    "close_cpu_5000": "close_tpu_5000",
 }
+ALL_NAMES = [s[0] for s in SCRIPT_STEPS] + [s[0] for s in CLOSE_STEPS]
 
 
 def log(msg):
@@ -76,67 +77,87 @@ def save_state(st):
         json.dump(st, f, indent=1)
 
 
-def probe_alive(timeout=90.0):
-    code = "import jax\nassert jax.devices()\nprint('ok')\n"
-    try:
-        p = subprocess.run(
-            [sys.executable, "-c", code],
-            timeout=timeout,
-            capture_output=True,
-            text=True,
-        )
-        return p.returncode == 0 and "ok" in p.stdout
-    except Exception:
-        return False
-
-
-def run_step(name, argv, timeout, env=None):
+def run_script_step(name, argv, timeout):
     log("step %s starting (timeout %ds)" % (name, timeout))
     t0 = time.monotonic()
-    full_env = dict(os.environ)
-    if env:
-        full_env.update(env)
     try:
         p = subprocess.run(
-            argv,
-            cwd=REPO,
-            timeout=timeout,
-            capture_output=True,
-            text=True,
-            env=full_env,
+            argv, cwd=REPO, timeout=timeout, capture_output=True, text=True
         )
     except subprocess.TimeoutExpired:
         log("step %s KILLED after %ds (relay died mid-step?)" % (name, timeout))
         return None
     dt = time.monotonic() - t0
-    out = (p.stdout or "") + ("\n--- stderr ---\n" + p.stderr if p.stderr else "")
+    out = (p.stdout or "") + (
+        "\n--- stderr ---\n" + p.stderr if p.stderr else ""
+    )
     with open("/tmp/relay_step_%s.log" % name, "w") as f:
         f.write(out)
     if p.returncode != 0:
         log(
             "step %s FAILED rc=%d in %.0fs (tail: %s)"
-            % (name, p.returncode, dt, (p.stderr or p.stdout or "").strip()[-200:])
+            % (name, p.returncode, dt,
+               (p.stderr or p.stdout or "").strip()[-200:])
         )
         return None
     log("step %s OK in %.0fs" % (name, dt))
     return p.stdout
 
 
-def run_cpu_close(name, n_txs, timeout):
-    code = (
-        "import jax\njax.config.update('jax_platforms', 'cpu')\n"
-        + _CLOSE_CHILD.format(n=n_txs)
-    )
-    return run_step(name, [sys.executable, "-u", "-c", code], timeout)
+def run_close_step(name, n_txs, backend, timeout):
+    """bench._close_in_subprocess with the backend pinned via the child
+    platform preamble (JAX_PLATFORMS env), verifying the result really ran
+    on the requested backend — a CPU-silent-fallback close must not be
+    recorded as a tpu measurement (review finding r05)."""
+    log("step %s starting (timeout %ds)" % (name, timeout))
+    prev = os.environ.get("JAX_PLATFORMS")
+    if backend == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    elif prev == "cpu":
+        del os.environ["JAX_PLATFORMS"]
+    t0 = time.monotonic()
+    try:
+        r = bench._close_in_subprocess(n_txs, 3, timeout=timeout)
+    except Exception as e:
+        # e.g. a truncated CLOSE_RESULT line when the relay dies mid-print:
+        # a step failure, never a watcher death (bench.py's own caller
+        # guards the same way)
+        r = {"ledger_close_error": "harness: %s" % str(e)[:200]}
+    finally:
+        if prev is None:
+            os.environ.pop("JAX_PLATFORMS", None)
+        else:
+            os.environ["JAX_PLATFORMS"] = prev
+    dt = time.monotonic() - t0
+    with open("/tmp/relay_step_%s.log" % name, "w") as f:
+        f.write(json.dumps(r, indent=1))
+    if "ledger_close_error" in r:
+        log("step %s FAILED in %.0fs: %s"
+            % (name, dt, r["ledger_close_error"][:200]))
+        return None
+    got = r.get("ledger_close_sig_backend")
+    if got != backend:
+        log("step %s FAILED: ran on backend %r, wanted %r" % (name, got, backend))
+        return None
+    log("step %s OK in %.0fs: p50=%sms" % (name, dt, r.get("ledger_close_p50_ms")))
+    return json.dumps(r)
+
+
+def pending_names(st):
+    return [n for n in ALL_NAMES if n not in st["done"]]
 
 
 def main():
     once = "--once" in sys.argv
+    # ambient BENCH_* knobs from manual runs must not leak into the close
+    # children (bench._close_in_subprocess honors BENCH_CLOSE_TIMEOUT /
+    # BENCH_CLOSE_FAKE_HANG — same hygiene as tests/test_bench.py)
+    for k in [k for k in os.environ if k.startswith("BENCH_")]:
+        del os.environ[k]
     st = load_state()
-    pending = [s for s in STEPS if s[0] not in st["done"]]
-    log("watcher up; pending: %s" % [s[0] for s in pending])
-    while pending:
-        if not probe_alive():
+    log("watcher up; pending: %s" % pending_names(st))
+    while pending_names(st):
+        if not bench._probe_tpu_alive():
             log("relay dead; sleeping 60s")
             if once:
                 return 1
@@ -145,33 +166,47 @@ def main():
         log("RELAY ALIVE — running pending steps")
         open(ACTIVE_FLAG, "w").write(str(os.getpid()))
         try:
-            for name, argv, timeout in list(pending):
+            runners = [
+                (name, lambda a=argv, t=timeout, n=name:
+                    run_script_step(n, a, t))
+                for name, argv, timeout in SCRIPT_STEPS
+            ] + [
+                (name, lambda n=name, nt=n_txs, b=backend, t=timeout:
+                    run_close_step(n, nt, b, t))
+                for name, n_txs, backend, timeout in CLOSE_STEPS
+            ]
+            for name, runner in runners:
+                if name in st["done"]:
+                    continue
+                gate = PAIR_GATE.get(name)
+                if gate is not None and gate not in st["done"]:
+                    continue  # wait for the tpu pair (same-window control)
                 st["attempts"][name] = st["attempts"].get(name, 0) + 1
-                out = run_step(name, argv, timeout)
-                if out is not None:
-                    st["done"][name] = out.strip()[-2000:]
+                out = runner()
+                if out is None:
                     save_state(st)
-                    if name in CPU_AFTER:
-                        cname, n, ct = CPU_AFTER[name]
-                        cout = run_cpu_close(cname, n, ct)
-                        if cout is not None:
-                            st["done"][cname] = cout.strip()[-2000:]
-                            save_state(st)
-                else:
-                    save_state(st)
-                    break  # re-probe before burning the next step's budget
+                    # a step can fail because the window died OR because
+                    # the step itself is broken; re-probe to tell them
+                    # apart — a live relay means keep going so one broken
+                    # step can't starve the rest of the checklist
+                    if not bench._probe_tpu_alive():
+                        log("window died; back to probing")
+                        break
+                    continue
+                st["done"][name] = out.strip()[-2000:]
+                save_state(st)
         finally:
             try:
                 os.unlink(ACTIVE_FLAG)
             except OSError:
                 pass
-        pending = [s for s in STEPS if s[0] not in st["done"]]
-        if pending and not once:
+        if pending_names(st) and not once:
             time.sleep(20)
         elif once:
             break
-    log("all steps done" if not pending else "exiting with pending steps")
-    return 0
+    left = pending_names(st)
+    log("all steps done" if not left else "exiting with pending: %s" % left)
+    return 0 if not left else 1
 
 
 if __name__ == "__main__":
