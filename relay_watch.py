@@ -151,9 +151,12 @@ def main():
     once = "--once" in sys.argv
     # ambient BENCH_* knobs from manual runs must not leak into the close
     # children (bench._close_in_subprocess honors BENCH_CLOSE_TIMEOUT /
-    # BENCH_CLOSE_FAKE_HANG — same hygiene as tests/test_bench.py)
+    # BENCH_CLOSE_FAKE_HANG — same hygiene as tests/test_bench.py); an
+    # ambient JAX_PLATFORMS=cpu would make every relay probe a false
+    # positive (the probe child honors it via the platform preamble)
     for k in [k for k in os.environ if k.startswith("BENCH_")]:
         del os.environ[k]
+    os.environ.pop("JAX_PLATFORMS", None)
     st = load_state()
     log("watcher up; pending: %s" % pending_names(st))
     while pending_names(st):

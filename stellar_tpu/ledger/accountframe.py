@@ -184,22 +184,43 @@ class AccountFrame(EntryFrame):
             entry_cache.clear()
 
     @classmethod
-    def load_account(cls, account_id: PublicKey, db) -> Optional["AccountFrame"]:
+    def load_account(
+        cls, account_id: PublicKey, db, readonly: bool = False
+    ) -> Optional["AccountFrame"]:
+        """readonly=True skips the defensive cache-hit copy: the returned
+        frame SHARES the cached entry and must never be mutated or stored
+        (EntryFrame._assert_mutable enforces the store half).  Validation
+        paths load ~3x per tx and only read — the copy is ~40% of a warm
+        load (PROFILE.md round-5)."""
         # account cache keys are prefix+pubkey on the wire; building the
         # bytes directly skips two XDR packs on the hottest load path
         kb = _ACCT_KEY_PREFIX + account_id.value
         key = LedgerKey(LedgerEntryType.ACCOUNT, LedgerKeyAccount(account_id))
         key._kb = kb
-        hit, cached = cls.cache_of(db).get(kb)
+        cache = cls.cache_of(db)
+        hit, cached = cache.peek(kb) if readonly else cache.get(kb)
         if hit:
-            return cls(cached) if cached else None
+            if cached is None:
+                return None
+            frame = cls(cached)
+            if readonly:
+                frame._readonly = True
+            return frame
         buf = active_buffer(db)
         if buf is not None:
             # pending write evicted from the LRU: the overlay, not SQL, is
             # authoritative for any key it holds
             hit, pending = buf.get(kb)
             if hit:
-                return cls(xdr_copy(pending)) if pending is not None else None
+                if pending is None:
+                    return None
+                if readonly:
+                    # buffer snapshots are immutable by contract
+                    # (EntryFrame._record: "all sides only read")
+                    frame = cls(pending)
+                    frame._readonly = True
+                    return frame
+                return cls(xdr_copy(pending))
         aid = _aid(account_id)
         with db.timed("select", "account"):
             row = db.query_one(
@@ -238,6 +259,11 @@ class AccountFrame(EntryFrame):
         entry = LedgerEntry(lastmod, LedgerEntryData(LedgerEntryType.ACCOUNT, ae), 0)
         frame = cls(entry)
         cls.store_in_cache(db, key, entry)
+        if readonly:
+            # the miss-path frame owns its entry (store_in_cache copies),
+            # but readonly must behave identically hit or miss — a caller
+            # whose mutation "works" only on cold loads is a hidden bug
+            frame._readonly = True
         return frame
 
     @classmethod
@@ -339,10 +365,14 @@ class AccountFrame(EntryFrame):
             s.sort(key=lambda sg: sg.pubKey.value)
 
     def store_add(self, delta, db) -> None:
+        # guard BEFORE _normalize: its in-place signer sort would mutate a
+        # readonly frame's cache-shared entry, then raise — too late
+        self._assert_mutable()
         self._normalize()
         super().store_add(delta, db)
 
     def store_change(self, delta, db) -> None:
+        self._assert_mutable()
         self._normalize()
         super().store_change(delta, db)
 
@@ -395,6 +425,7 @@ class AccountFrame(EntryFrame):
             )
 
     def store_delete(self, delta, db) -> None:
+        self._assert_mutable()
         if not self._buffered_delete(db, self.get_key()):
             aid = _aid(self.account.accountID)
             with db.timed("delete", "account"):

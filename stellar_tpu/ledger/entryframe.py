@@ -46,6 +46,18 @@ class EntryCache:
         self.misses += 1
         return False, None
 
+    def peek(self, key: bytes):
+        """(hit, SHARED-entry-or-None) — no defensive copy.  The caller
+        must treat the entry as immutable (read-only load path); a later
+        put_owned replaces the cache line's reference, never mutates it,
+        so a peeked entry stays consistent as of its load."""
+        if key in self._map:
+            self._map.move_to_end(key)
+            self.hits += 1
+            return True, self._map[key]
+        self.misses += 1
+        return False, None
+
     def put(self, key: bytes, entry: Optional[LedgerEntry]):
         self.put_owned(key, xdr_copy(entry) if entry is not None else None)
 
@@ -92,6 +104,11 @@ class EntryFrame:
 
     entry_type: LedgerEntryType = None
 
+    # True on frames from a read-only load: the wrapped entry is SHARED
+    # with the entry cache (no defensive copy), so any store is a bug —
+    # guarded in store_add/store_change/store_delete
+    _readonly = False
+
     def __init__(self, entry: LedgerEntry):
         self.entry = entry
         self.m_key_calculated = False
@@ -119,13 +136,23 @@ class EntryFrame:
         return type(self)(xdr_copy(self.entry))
 
     # -- store interface ---------------------------------------------------
+    def _assert_mutable(self) -> None:
+        if self._readonly:
+            raise RuntimeError(
+                f"store through a read-only {type(self).__name__} — its "
+                "entry is shared with the entry cache; load without "
+                "readonly=True to mutate"
+            )
+
     def store_add(self, delta, db) -> None:
+        self._assert_mutable()
         self._stamp(delta)
         if active_buffer(db) is None:
             self._persist(db, insert=True)
         self._record(delta, db, created=True)
 
     def store_change(self, delta, db) -> None:
+        self._assert_mutable()
         self._stamp(delta)
         if active_buffer(db) is None:
             self._persist(db, insert=False)

@@ -277,6 +277,7 @@ class OfferFrame(EntryFrame):
                 )
 
     def store_delete(self, delta, db) -> None:
+        self._assert_mutable()
         if not self._buffered_delete(db, self.get_key()):
             with db.timed("delete", "offer"):
                 db.execute(

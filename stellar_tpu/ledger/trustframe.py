@@ -246,6 +246,7 @@ class TrustFrame(EntryFrame):
         super().store_change(delta, db)
 
     def store_delete(self, delta, db) -> None:
+        self._assert_mutable()
         assert not self.is_issuer
         if not self._buffered_delete(db, self.get_key()):
             tl = self.trust_line

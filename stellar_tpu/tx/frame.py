@@ -196,7 +196,7 @@ class TransactionFrame:
             if aid.value in seen_accounts:
                 continue
             seen_accounts.add(aid.value)
-            af = AccountFrame.load_account(aid, db)
+            af = AccountFrame.load_account(aid, db, readonly=True)
             if af is None:
                 continue
             keys = []
@@ -210,9 +210,14 @@ class TransactionFrame:
         return triples
 
     # -- account loading ---------------------------------------------------
-    def load_account(self, db):
-        """(Re)load the tx source into signing_account."""
-        self.signing_account = AccountFrame.load_account(self.get_source_id(), db)
+    def load_account(self, db, readonly: bool = False):
+        """(Re)load the tx source into signing_account.  readonly skips
+        the defensive cache copy — validation-path loads (check_valid /
+        txset chain checks) only read; the apply path reloads mutable via
+        common_valid(applying=True) and process_fee_seq_num."""
+        self.signing_account = AccountFrame.load_account(
+            self.get_source_id(), db, readonly=readonly
+        )
         return self.signing_account
 
     def load_account_shared(self, db, account_id: PublicKey):
@@ -249,7 +254,7 @@ class TransactionFrame:
         if tx.fee < self.get_min_fee(lm):
             return invalid("insufficient-fee", TransactionResultCode.txINSUFFICIENT_FEE)
 
-        if not self.load_account(app.database):
+        if not self.load_account(app.database, readonly=not applying):
             return invalid("no-account", TransactionResultCode.txNO_ACCOUNT)
 
         # when applying, the seq num was already bumped by processFeeSeqNum

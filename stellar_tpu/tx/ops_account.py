@@ -184,7 +184,7 @@ class SetOptionsOpFrame(OperationFrame):
             return False
 
         if so.inflationDest is not None:
-            if AccountFrame.load_account(so.inflationDest, db) is None:
+            if AccountFrame.load_account(so.inflationDest, db, readonly=True) is None:
                 return fail(
                     "invalid-inflation",
                     SetOptionsResultCode.SET_OPTIONS_INVALID_INFLATION,

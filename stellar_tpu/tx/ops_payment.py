@@ -211,7 +211,12 @@ class PathPaymentOpFrame(OperationFrame):
             if cur_a == cur_b:
                 continue
             if not cur_a.is_native():
-                if AccountFrame.load_account(cur_a.code_and_issuer()[1], db) is None:
+                if (
+                    AccountFrame.load_account(
+                        cur_a.code_and_issuer()[1], db, readonly=True
+                    )
+                    is None
+                ):
                     return self._fail(
                         metrics,
                         "no-issuer",

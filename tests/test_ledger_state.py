@@ -348,3 +348,54 @@ class TestCoinConservation:
         finally:
             app.graceful_stop()
             clock.shutdown()
+
+
+class TestReadonlyLoads:
+    """Read-only loads share the cached entry (no defensive copy) and are
+    store-guarded — the validation path's 3-loads-per-tx never mutate
+    (PROFILE.md round-5 close split)."""
+
+    def _stored(self, db, header, i=31):
+        aid = mk_account(i)
+        delta = LedgerDelta(header, db)
+        af = AccountFrame(account_id=aid)
+        af.set_balance(10**9)
+        af.set_seq_num(1 << 32)
+        af.store_add(delta, db)
+        return aid
+
+    def test_readonly_hit_shares_cache_entry(self, db, header):
+        aid = self._stored(db, header)
+        ro = AccountFrame.load_account(aid, db, readonly=True)
+        rw = AccountFrame.load_account(aid, db)
+        assert ro.get_balance() == rw.get_balance() == 10**9
+        # rw owns a private copy; ro shares the cache line
+        assert rw.entry is not ro.entry
+        ro2 = AccountFrame.load_account(aid, db, readonly=True)
+        assert ro2.entry is ro.entry
+
+    def test_readonly_store_is_refused(self, db, header):
+        aid = self._stored(db, header, 32)
+        ro = AccountFrame.load_account(aid, db, readonly=True)
+        delta = LedgerDelta(header, db)
+        with pytest.raises(RuntimeError, match="read-only"):
+            ro.store_change(delta, db)
+        with pytest.raises(RuntimeError, match="read-only"):
+            ro.store_delete(delta, db)
+
+    def test_readonly_refuses_store_on_cold_load_too(self, db, header):
+        # identical semantics hit or miss: a mutation that "works" only on
+        # cold loads would be a hidden bug
+        aid = self._stored(db, header, 33)
+        AccountFrame.cache_of(db).clear()
+        ro = AccountFrame.load_account(aid, db, readonly=True)
+        delta = LedgerDelta(header, db)
+        with pytest.raises(RuntimeError, match="read-only"):
+            ro.store_change(delta, db)
+
+    def test_mutable_load_still_isolated_from_cache(self, db, header):
+        aid = self._stored(db, header, 34)
+        rw = AccountFrame.load_account(aid, db)
+        rw.account.balance = 7  # never stored
+        again = AccountFrame.load_account(aid, db, readonly=True)
+        assert again.get_balance() == 10**9
