@@ -38,13 +38,8 @@ class EntryCache:
 
     def get(self, key: bytes):
         """(hit, entry-copy-or-None); the caller owns the returned entry."""
-        if key in self._map:
-            self._map.move_to_end(key)
-            self.hits += 1
-            e = self._map[key]
-            return True, (xdr_copy(e) if e is not None else None)
-        self.misses += 1
-        return False, None
+        hit, e = self.peek(key)
+        return hit, (xdr_copy(e) if hit and e is not None else None)
 
     def peek(self, key: bytes):
         """(hit, SHARED-entry-or-None) — no defensive copy.  The caller

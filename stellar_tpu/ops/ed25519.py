@@ -20,6 +20,7 @@ Curve math dataflow is pure int32; batch axis N rides the TPU vector lanes
 from __future__ import annotations
 
 import hashlib
+import os
 import time
 from functools import partial
 from typing import List, Optional, Sequence, Tuple
@@ -303,10 +304,20 @@ class BatchVerifier:
         mesh=None,
         min_device_batch: int = 16,
         backend: str = "auto",
+        streams: Optional[int] = None,
     ):
         self.max_batch = max_batch
         self.min_device_batch = min_device_batch
         self.mesh = mesh
+        if streams is None:
+            streams = int(os.environ.get("STELLAR_TPU_VERIFY_STREAMS", "1"))
+        # dispatch streams: stager threads that stage+upload+launch chunks
+        # concurrently.  1 = the classic pipeline (host prep of chunk k+1
+        # overlaps device drain of chunk k).  2 = additionally overlap one
+        # chunk's relay UPLOAD with another's EXECUTION — a win only if
+        # the transport allows it (probe_overlap.py measures this; bench
+        # A/Bs both and reports the better)
+        self.streams = max(1, streams)
         if backend == "auto":
             # pallas is a TPU (Mosaic) lowering: not CPU, and not GPU
             # either (interpret mode exists but is far slower than XLA)
@@ -330,6 +341,12 @@ class BatchVerifier:
         self.n_items = 0
         self.n_gate_rejects = 0
         self.verify_seconds = 0.0
+        # n_device_calls is bumped from every stager thread; += alone
+        # drops increments under streams>1 and the counter feeds
+        # profiling conclusions
+        import threading
+
+        self._calls_lock = threading.Lock()
 
     def _make_kernel(self):
         if self.mesh is not None:
@@ -437,21 +454,24 @@ class BatchVerifier:
             import threading
             from concurrent.futures import ThreadPoolExecutor
 
-            sem = threading.Semaphore(PIPELINE_DEPTH)
+            # with >1 streams, each stream needs an in-flight slot plus
+            # one being drained, or the second stream can never overlap
+            depth = max(PIPELINE_DEPTH, self.streams + 1)
+            sem = threading.Semaphore(depth)
 
             def stage_and_dispatch(c):
                 staged = self._stage_chunk(c)  # host prep runs ahead freely
                 sem.acquire()  # bound un-drained device buffers in flight
                 return self._dispatch_staged(staged)
 
-            with ThreadPoolExecutor(max_workers=1) as stager:
+            with ThreadPoolExecutor(max_workers=self.streams) as stager:
                 futs = [
                     (c, stager.submit(stage_and_dispatch, c)) for c in chunks
                 ]
                 try:
                     for chunk, f in futs:
                         pending.append((chunk, f.result()))
-                        if len(pending) >= PIPELINE_DEPTH:
+                        if len(pending) >= depth:
                             drain_one()
                             sem.release()
                     while pending:
@@ -519,7 +539,8 @@ class BatchVerifier:
                 jnp.asarray(_nibbles_np(s_bytes)),
                 jnp.asarray(_nibbles_np(h_bytes)),
             )
-        self.n_device_calls += 1
+        with self._calls_lock:
+            self.n_device_calls += 1
         return ok
 
     def _dispatch_chunk(self, chunk):

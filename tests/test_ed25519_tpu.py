@@ -441,3 +441,39 @@ class TestShardedVerifier:
             g.dryrun_multichip(8)
         finally:
             sys.path.pop(0)
+
+
+class TestMultiStream:
+    def test_two_stream_pipeline_matches_single(self):
+        """streams=2 runs two stage+dispatch workers (upload/execute
+        overlap on a pipelining transport); results and ordering must be
+        identical to the classic 1-stream pipeline, including scattered
+        gate rejects."""
+        from stellar_tpu.ops.ed25519 import BatchVerifier
+
+        items = []
+        for i in range(16 * 5):  # 5 chunks
+            sk = SecretKey.pseudo_random_for_testing(i)
+            msg = b"stream test %d" % i
+            items.append((sk.public_raw, msg, sk.sign(msg)))
+        # corrupt a few spread across chunks; one malformed length
+        items[3] = (items[3][0], items[3][1], b"\x00" * 64)
+        items[40] = (items[40][0], b"wrong msg", items[40][2])
+        items[70] = (items[70][0][:31], items[70][1], items[70][2])
+
+        bv1 = BatchVerifier(max_batch=16, streams=1)
+        bv2 = BatchVerifier(max_batch=16, streams=2)
+        out1 = bv1.verify(items)
+        out2 = bv2.verify(items)
+        assert out1 == out2
+        assert not out2[3] and not out2[40] and not out2[70]
+        assert sum(out2) == len(items) - 3
+
+    def test_streams_env_default(self, monkeypatch):
+        from stellar_tpu.ops.ed25519 import BatchVerifier
+
+        monkeypatch.setenv("STELLAR_TPU_VERIFY_STREAMS", "2")
+        assert BatchVerifier(max_batch=16).streams == 2
+        monkeypatch.delenv("STELLAR_TPU_VERIFY_STREAMS")
+        assert BatchVerifier(max_batch=16).streams == 1
+        assert BatchVerifier(max_batch=16, streams=3).streams == 3
