@@ -354,6 +354,10 @@ def _main():
     if want_2s and (pinned == "2" or deadline - time.monotonic() > 120.0):
         _progress.update(stage="verify-2stream")
         bv2 = BatchVerifier(max_batch=batch, streams=2)
+        # streams only changes host-side threading: share the headline
+        # leg's kernel object so the XLA-backend path cannot retrace
+        # (the pallas path is a module-level jitted fn, already shared)
+        bv2._kernel = bv._kernel
         try:
             out = _retry(lambda: bv2.verify(items), tag="2-stream warmup")
             assert all(out)

@@ -451,40 +451,48 @@ class BatchVerifier:
             while pending:
                 drain_one()
         else:
-            import threading
             from concurrent.futures import ThreadPoolExecutor
 
-            # with >1 streams, each stream needs an in-flight slot plus
-            # one being drained, or the second stream can never overlap
+            # Bound SUBMITTED-but-undrained chunks at `depth`: a queued
+            # future can start the moment a worker frees, so the
+            # submission count is the device in-flight bound.  The bound
+            # lives in a plain main-thread counter, NOT a semaphore
+            # acquired on the workers — with streams>1 a later chunk's
+            # worker could steal the last permit out of chunk order while
+            # the main thread blocks on an earlier chunk's future that
+            # can then never dispatch (deadlock, r05 review).  With >1
+            # streams each needs an in-flight slot plus one being
+            # drained, or the second stream can never overlap.
             depth = max(PIPELINE_DEPTH, self.streams + 1)
-            sem = threading.Semaphore(depth)
 
             def stage_and_dispatch(c):
-                staged = self._stage_chunk(c)  # host prep runs ahead freely
-                sem.acquire()  # bound un-drained device buffers in flight
+                staged = self._stage_chunk(c)
                 return self._dispatch_staged(staged)
 
             with ThreadPoolExecutor(max_workers=self.streams) as stager:
-                futs = [
-                    (c, stager.submit(stage_and_dispatch, c)) for c in chunks
-                ]
+                futs = []
+                drained = 0
+
+                def drain_oldest():
+                    nonlocal drained
+                    chunk, f = futs[drained]
+                    drained += 1
+                    pending.append((chunk, f.result()))
+                    drain_one()
+
                 try:
-                    for chunk, f in futs:
-                        pending.append((chunk, f.result()))
-                        if len(pending) >= depth:
-                            drain_one()
-                            sem.release()
-                    while pending:
-                        drain_one()
-                        sem.release()
+                    for c in chunks:
+                        if len(futs) - drained >= depth:
+                            drain_oldest()
+                        futs.append((c, stager.submit(stage_and_dispatch, c)))
+                    while drained < len(futs):
+                        drain_oldest()
                 except BaseException:
-                    # unblock the stager (it may sit in sem.acquire with no
-                    # further releases coming) and drop queued work, or the
-                    # executor __exit__ would deadlock instead of raising
+                    # drop queued work; running workers just finish their
+                    # chunk (nothing blocks on a lock), so executor
+                    # __exit__ joins cleanly and the error propagates
                     for _, f in futs:
                         f.cancel()
-                    for _ in range(len(chunks)):
-                        sem.release()
                     raise
         # wall time of the whole batched call: staging + hashing + device
         # compute + sync (NOT device-only — see stats())

@@ -477,3 +477,51 @@ class TestMultiStream:
         monkeypatch.delenv("STELLAR_TPU_VERIFY_STREAMS")
         assert BatchVerifier(max_batch=16).streams == 1
         assert BatchVerifier(max_batch=16, streams=3).streams == 3
+
+    def test_out_of_order_staging_cannot_deadlock(self):
+        """With streams=2, a later chunk staging FASTER than an earlier one
+        once deadlocked the pipeline (the later chunk's worker stole the
+        last in-flight permit while the main thread blocked on the earlier
+        chunk's future).  The in-flight bound now lives in a main-thread
+        submission counter; this pins the fix by making every even chunk
+        stage slowly."""
+        import threading
+
+        import numpy as np
+
+        from stellar_tpu.ops.ed25519 import BatchVerifier
+
+        bv = BatchVerifier(max_batch=16, streams=2)
+        real_stage = bv._stage_chunk
+        idx_lock = threading.Lock()
+        seen = []
+
+        def slow_even_stage(chunk):
+            with idx_lock:
+                i = len(seen)
+                seen.append(i)
+            if i % 2 == 0:
+                import time
+
+                time.sleep(0.05)  # even chunks stage slower than odd ones
+            return real_stage(chunk)
+
+        bv._stage_chunk = slow_even_stage
+        bv._dispatch_staged = lambda staged: np.ones(
+            0 if staged is None else staged[0].shape[0], dtype=bool
+        )
+        items = []
+        for i in range(16 * 8):  # 8 chunks through both streams
+            sk = SecretKey.pseudo_random_for_testing(i)
+            msg = b"deadlock probe %d" % i
+            items.append((sk.public_raw, msg, sk.sign(msg)))
+        outcome = []
+
+        def run():
+            outcome.append(bv.verify(items))
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        t.join(timeout=30)
+        assert not t.is_alive(), "2-stream pipeline deadlocked"
+        assert outcome and all(outcome[0])

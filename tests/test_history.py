@@ -390,3 +390,130 @@ def test_publish_catchup_alternation_with_stall(clock, fresh_archive, monkeypatc
         pub.graceful_stop()
         for f in followers.values():
             f.graceful_stop()
+
+
+# -- S3-style remote object-store archive ----------------------------------
+# Reference: HistoryTests.cpp:827-870 S3Configurator — get/put command
+# templates against an object store ("aws s3 cp ..."), EMPTY mkdir (object
+# stores have no directories).  Hermetic port: a localhost HTTP object
+# server stands in for S3; templates shell out to urllib one-liners, so
+# every byte of publish+catchup rides a network transport, not cp.
+
+
+class _ObjectStore:
+    """In-memory HTTP object store: PUT stores the body at the path, GET
+    serves it back (404 when absent) — the S3 semantics the archive
+    templates need."""
+
+    def __init__(self):
+        import threading
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        objects = self.objects = {}
+
+        class H(BaseHTTPRequestHandler):
+            def do_PUT(self):
+                body = self.rfile.read(int(self.headers["Content-Length"]))
+                objects[self.path] = body
+                self.send_response(200)
+                self.end_headers()
+
+            def do_GET(self):
+                body = objects.get(self.path)
+                if body is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.port = self.server.server_address[1]
+        self.thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True
+        )
+        self.thread.start()
+
+    def stop(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+_S3GET = (
+    "import sys, urllib.request\n"
+    "url, local = sys.argv[1], sys.argv[2]\n"
+    "data = urllib.request.urlopen(url, timeout=10).read()\n"
+    "open(local, 'wb').write(data)\n"
+)
+_S3PUT = (
+    "import sys, urllib.request\n"
+    "local, url = sys.argv[1], sys.argv[2]\n"
+    "req = urllib.request.Request(\n"
+    "    url, data=open(local, 'rb').read(), method='PUT')\n"
+    "urllib.request.urlopen(req, timeout=10).read()\n"
+)
+
+
+def s3_archive_config(tmp_path, port: int, writable: bool) -> dict:
+    import sys
+
+    get_py = tmp_path / "s3get.py"
+    put_py = tmp_path / "s3put.py"
+    get_py.write_text(_S3GET)
+    put_py.write_text(_S3PUT)
+    base = f"http://127.0.0.1:{port}"
+    # {0}=remote {1}=local for get; {0}=local {1}=remote for put
+    # (HistoryArchive.put_file_cmd, matching the reference's putFileCmd);
+    # mkdir stays EMPTY like S3Configurator — publish must cope with an
+    # archive that has no mkdir at all
+    spec = {"get": f"{sys.executable} {get_py} {base}/{{0}} {{1}}"}
+    if writable:
+        spec["put"] = f"{sys.executable} {put_py} {{0}} {base}/{{1}}"
+    return {"test": spec}
+
+
+def test_publish_catchup_via_s3_style_object_store(clock, tmp_path):
+    store = _ObjectStore()
+    try:
+        cfg_pub = s3_archive_config(tmp_path, store.port, writable=True)
+        app1 = make_app(clock, 28, str(tmp_path / "unused-pub"), True)
+        app1.config.HISTORY = cfg_pub
+        try:
+            made = publish_checkpoint(app1, clock, accounts=True)
+            assert made
+            lcl1 = app1.ledger_manager.last_closed
+        finally:
+            app1.graceful_stop()
+
+        # everything landed as objects over HTTP, not files
+        assert any(
+            k.startswith("/ledger/") for k in store.objects
+        ), sorted(store.objects)
+        assert "/.well-known/stellar-history.json" in store.objects
+
+        app2 = make_app(clock, 29, str(tmp_path / "unused-sub"), False)
+        app2.config.HISTORY = s3_archive_config(
+            tmp_path, store.port, writable=False
+        )
+        try:
+            app2.config.CATCHUP_COMPLETE = True
+            lm2 = app2.ledger_manager
+            lm2.start_catchup()
+            assert clock.crank_until(
+                lambda: lm2.state == LedgerState.LM_SYNCED_STATE, 60
+            )
+            assert lm2.last_closed.hash == lcl1.hash
+            for dest in made:
+                af = AccountFrame.load_account(
+                    dest.get_public_key(), app2.database
+                )
+                assert af is not None and af.get_balance() == 200_000_000
+        finally:
+            app2.graceful_stop()
+    finally:
+        store.stop()
