@@ -237,15 +237,11 @@ class MetricsRegistry:
         self._by_parts: Dict[tuple, object] = {}
 
     def _get(self, parts, factory, want_type):
-        # fast path: (tuple-parts, type) memo hit — no join, no isinstance
-        # chain, no factory allocation.  Keying on the type keeps _get's
-        # collision guard intact for memo hits too.
-        memo_key = None
-        if isinstance(parts, tuple):
-            memo_key = (parts, want_type)
-            m = self._by_parts.get(memo_key)
-            if m is not None:
-                return m
+        # slow path only: the new_* accessors check the (tuple-parts, type)
+        # memo inline BEFORE building the factory closure, so reaching
+        # here with tuple parts means a guaranteed memo miss — no second
+        # probe.  Keying on the type keeps the collision guard intact.
+        memo_key = (parts, want_type) if isinstance(parts, tuple) else None
         name = self._name(parts)
         m = self._metrics.get(name)
         if m is None:
@@ -264,16 +260,28 @@ class MetricsRegistry:
     def _name(parts) -> str:
         return ".".join(parts) if not isinstance(parts, str) else parts
 
+    # the new_* accessors are on the per-op apply path (~3 calls/tx); on a
+    # memo hit, return before allocating the factory closure _get takes —
+    # the lambda alone costs more than the memo lookup
+
     def new_counter(self, parts) -> Counter:
-        return self._get(parts, Counter, Counter)
+        m = self._by_parts.get((parts, Counter)) if type(parts) is tuple else None
+        return m if m is not None else self._get(parts, Counter, Counter)
 
     def new_meter(self, parts, event_type: str = "event") -> Meter:
+        m = self._by_parts.get((parts, Meter)) if type(parts) is tuple else None
+        if m is not None:
+            return m
         return self._get(parts, lambda: Meter(event_type, self._clock), Meter)
 
     def new_histogram(self, parts) -> Histogram:
-        return self._get(parts, Histogram, Histogram)
+        m = self._by_parts.get((parts, Histogram)) if type(parts) is tuple else None
+        return m if m is not None else self._get(parts, Histogram, Histogram)
 
     def new_timer(self, parts) -> Timer:
+        m = self._by_parts.get((parts, Timer)) if type(parts) is tuple else None
+        if m is not None:
+            return m
         return self._get(parts, lambda: Timer(self._clock), Timer)
 
     def get(self, parts):
