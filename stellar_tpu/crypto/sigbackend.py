@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Any, Iterable, List, Sequence, Tuple
+from typing import Any, Iterable, List, Optional, Sequence, Tuple
 
 from ..util import xlog
 from . import sodium
@@ -133,10 +133,13 @@ class TpuSigBackend(SigBackend):
         max_batch: int = 4096,
         mesh=None,
         cpu_cutover: int = DEFAULT_TPU_CPU_CUTOVER,
+        streams: Optional[int] = None,
     ):
         from ..ops.ed25519 import BatchVerifier  # lazy: JAX import
 
-        self._verifier = BatchVerifier(max_batch=max_batch, mesh=mesh)
+        self._verifier = BatchVerifier(
+            max_batch=max_batch, mesh=mesh, streams=streams
+        )
         # Below this many cache misses a device round-trip costs more than
         # looping libsodium on host — lone SCP envelopes and small tx sets
         # must never pay device latency just because the backend is "tpu"

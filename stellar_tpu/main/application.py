@@ -56,6 +56,7 @@ class Application:
             config.SIGNATURE_BACKEND,
             max_batch=config.SIG_BATCH_MAX,
             cpu_cutover=config.TPU_CPU_CUTOVER,
+            streams=config.SIG_VERIFY_STREAMS,
         )
         self.bucket_manager = BucketManager(self)
         self.ledger_manager = LedgerManager(self)

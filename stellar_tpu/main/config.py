@@ -7,6 +7,7 @@ libsodium; we route every verify through the chosen SigBackend).
 
 from __future__ import annotations
 
+import os
 import tomllib
 from typing import Dict, List, Optional
 
@@ -70,6 +71,16 @@ class Config:
         # TPU-native addition: which SigBackend serves batch verifies
         self.SIGNATURE_BACKEND = "cpu"
         self.SIG_BATCH_MAX = 4096
+        # dispatch streams for multi-chunk verify batches: 2 overlaps one
+        # chunk's transport upload with another's execution — worth it
+        # only when the accelerator transport pipelines (probe_overlap.py
+        # measures; ops/ed25519.py BatchVerifier docs).  The TOML knob
+        # wins; its default honors the STELLAR_TPU_VERIFY_STREAMS env var
+        # so the documented operator override keeps working on the node
+        # path too
+        self.SIG_VERIFY_STREAMS = int(
+            os.environ.get("STELLAR_TPU_VERIFY_STREAMS", "1")
+        )
         # below this many cache-miss verifies the tpu backend loops
         # libsodium instead of paying a device round-trip (tests set 0 to
         # force every batch onto the device path; breakeven arithmetic at
@@ -134,6 +145,14 @@ class Config:
             raise ValueError("QUORUM_SET threshold must be > 0")
         if self.SIGNATURE_BACKEND not in ("cpu", "tpu"):
             raise ValueError(f"bad SIGNATURE_BACKEND {self.SIGNATURE_BACKEND!r}")
+        if not (
+            isinstance(self.SIG_VERIFY_STREAMS, int)
+            and self.SIG_VERIFY_STREAMS >= 1
+        ):
+            raise ValueError(
+                f"SIG_VERIFY_STREAMS must be an int >= 1, "
+                f"got {self.SIG_VERIFY_STREAMS!r}"
+            )
 
     def to_short_string(self, pk: PublicKey) -> str:
         s = PubKeyUtils.to_strkey(pk)

@@ -478,6 +478,12 @@ class TestMultiStream:
         assert BatchVerifier(max_batch=16).streams == 1
         assert BatchVerifier(max_batch=16, streams=3).streams == 3
 
+    def test_streams_plumbs_through_sig_backend(self):
+        from stellar_tpu.crypto.sigbackend import TpuSigBackend
+
+        be = TpuSigBackend(max_batch=16, streams=2)
+        assert be._verifier.streams == 2
+
     def test_out_of_order_staging_cannot_deadlock(self):
         """With streams=2, a later chunk staging FASTER than an earlier one
         once deadlocked the pipeline (the later chunk's worker stole the

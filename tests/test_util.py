@@ -192,3 +192,30 @@ class TestTmpDir:
         assert os.path.isdir(d.get_name())
         mgr.forget(d)
         assert not os.path.exists(d.get_name())
+
+
+class TestConfigStreamsKnob:
+    def test_sig_verify_streams_validation(self):
+        # the TpuSigBackend plumbing assertion lives in the jax-guarded
+        # tests/test_ed25519_tpu.py TestMultiStream
+        import pytest
+
+        from stellar_tpu.main.config import Config
+
+        cfg = Config()
+        assert cfg.SIG_VERIFY_STREAMS >= 1
+        cfg.validate()
+        cfg.SIG_VERIFY_STREAMS = 0
+        with pytest.raises(ValueError, match="SIG_VERIFY_STREAMS"):
+            cfg.validate()
+        cfg.SIG_VERIFY_STREAMS = "2"
+        with pytest.raises(ValueError, match="SIG_VERIFY_STREAMS"):
+            cfg.validate()
+
+    def test_sig_verify_streams_env_default(self, monkeypatch):
+        from stellar_tpu.main.config import Config
+
+        monkeypatch.setenv("STELLAR_TPU_VERIFY_STREAMS", "2")
+        assert Config().SIG_VERIFY_STREAMS == 2
+        monkeypatch.delenv("STELLAR_TPU_VERIFY_STREAMS")
+        assert Config().SIG_VERIFY_STREAMS == 1
