@@ -15,6 +15,7 @@ share memoization exactly like the reference's gVerifySigCache.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Any, Iterable, List, Optional, Sequence, Tuple
@@ -70,6 +71,16 @@ class CachingSigBackend(SigBackend):
 
     def stats(self) -> dict:
         return self.inner.stats()
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if raw:
+        try:
+            return float(raw)
+        except ValueError:
+            _log.warning("ignoring malformed %s=%r; using %s", name, raw, default)
+    return default
 
 
 _pool = None
@@ -164,8 +175,14 @@ class TpuSigBackend(SigBackend):
     # legitimately take tens of seconds and must not false-latch a
     # healthy device (a false latch would self-heal after RETRY_INTERVAL,
     # but costs double work and misleading wedge telemetry).
-    DEVICE_TIMEOUT = 15.0
-    DEVICE_FIRST_TIMEOUT = 90.0
+    # Env-overridable: a loaded CI/test host can push the interpret-mode
+    # compile past 90s, and a false latch there fails device-path tests
+    # (tests/conftest.py raises the first-dispatch budget for exactly
+    # that; production keeps the measured defaults).  A malformed value
+    # falls back to the default — a typo'd budget must not kill the node
+    # at import.
+    DEVICE_TIMEOUT = _env_float("STELLAR_TPU_DISPATCH_BUDGET", 15.0)
+    DEVICE_FIRST_TIMEOUT = _env_float("STELLAR_TPU_FIRST_DISPATCH_BUDGET", 90.0)
     RETRY_INTERVAL = 60.0
 
     def verify_batch(self, items: Sequence[VerifyTriple]) -> List[bool]:

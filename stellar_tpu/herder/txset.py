@@ -33,6 +33,7 @@ class TxSetFrame:
         self.previous_ledger_hash = previous_ledger_hash
         self.transactions: List[TransactionFrame] = list(transactions or [])
         self._hash: Optional[bytes] = None
+        self._triples_memo: Optional[list] = None
 
     @classmethod
     def from_xdr_set(cls, network_id: bytes, xdr_set: TransactionSet) -> "TxSetFrame":
@@ -59,6 +60,7 @@ class TxSetFrame:
     def add_transaction(self, tx: TransactionFrame) -> None:
         self.transactions.append(tx)
         self._hash = None
+        self._triples_memo = None
 
     def remove_tx(self, tx: TransactionFrame) -> None:
         try:
@@ -66,6 +68,7 @@ class TxSetFrame:
         except ValueError:
             pass
         self._hash = None
+        self._triples_memo = None
 
     def size(self) -> int:
         return len(self.transactions)
@@ -125,10 +128,19 @@ class TxSetFrame:
 
     # -- shared validity core ----------------------------------------------
     def _collect_signature_triples(self, app) -> list:
-        triples = []
-        for tx in self.transactions:
-            triples.extend(tx.candidate_signature_pairs(app.database))
-        return triples
+        """Memoized per set: collection does a readonly account load per tx
+        (hint-matching needs the signers), and close_ledger prewarms the
+        same set check_valid just prewarmed.  The triples are a pure
+        prefetch — the eager check_signature path re-verifies anything the
+        batch missed — so a memo gone stale against DB signer changes can
+        only weaken the prefetch, never change a result.  Invalidated on
+        add_transaction/remove_tx."""
+        if self._triples_memo is None:
+            triples = []
+            for tx in self.transactions:
+                triples.extend(tx.candidate_signature_pairs(app.database))
+            self._triples_memo = triples
+        return self._triples_memo
 
     def _prewarm_signature_cache(self, app) -> None:
         """One SigBackend batch for the entire set (the TPU flush point)."""

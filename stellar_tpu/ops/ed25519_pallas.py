@@ -26,6 +26,7 @@ the same code path on the CPU mesh.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -37,6 +38,12 @@ from . import fe
 from . import ed25519 as ed
 
 NT = 512  # batch tile (lanes); must divide the padded batch
+
+# Compress-stage lane-tree Montgomery inversion (round-4 optimization,
+# ~11% modeled).  Env-switchable so profile_kernel.py can A/B it against
+# the per-lane pow-chain inversion within ONE relay window — cross-window
+# absolute comparisons are confounded by window quality (PROFILE.md).
+_BATCH_INV = os.environ.get("STELLAR_TPU_BATCH_INV", "1") != "0"
 
 _CONST_NAMES = ("SUB_PAD", "P_COL", "D", "D2", "SQRT_M1")
 
@@ -111,7 +118,7 @@ def _kernel(
             return acc
 
         acc = jax.lax.fori_loop(0, ed.WINDOWS, body, ed.point_identity(n))
-        enc = ed.compress(acc, batch_inv=True)
+        enc = ed.compress(acc, batch_inv=_BATCH_INV)
         match = jnp.all(enc == r_bytes, axis=0)
         out_ref[:] = (match & ~fail)[None]
 

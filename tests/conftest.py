@@ -15,6 +15,11 @@ import os
 import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# The tpu-backend tests run the Pallas kernel in interpret mode; its first
+# (compile-bearing) dispatch can exceed the production 90s watchdog budget
+# on a loaded host, and a false latch fails device-path assertions.  Tests
+# that exercise the watchdog itself set instance budgets explicitly.
+os.environ.setdefault("STELLAR_TPU_FIRST_DISPATCH_BUDGET", "600")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
