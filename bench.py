@@ -388,6 +388,44 @@ def _main():
             file=sys.stderr,
         )
 
+    # Host-assist A/B: peel cpu_rate/(cpu_rate+device_rate) of each batch
+    # onto a concurrent libsodium loop — the host core is otherwise idle
+    # while chunks upload/execute, so in an upload-bound window this adds
+    # roughly the libsodium rate on top.  Same kernel object, no retrace.
+    rate_ha = 0.0
+    ha_frac = 0.0
+    want_ha = (
+        not _platform_forced_cpu()
+        and os.environ.get("BENCH_HOST_ASSIST", "1") != "0"
+    )
+    if want_ha and rate > 0 and deadline - time.monotonic() > 120.0:
+        _progress.update(stage="verify-host-assist")
+        ha_frac = round(cpu_rate / (cpu_rate + rate), 3)
+        bv3 = BatchVerifier(max_batch=batch, streams=1, host_assist=ha_frac)
+        bv3._kernel = bv._kernel
+        try:
+            out = _retry(lambda: bv3.verify(items), tag="host-assist warmup")
+            assert all(out)
+            for _ in range(max(2, iters // 2)):
+                t0 = time.perf_counter()
+                out = _retry(lambda: bv3.verify(items), tag="host-assist pass")
+                dt = time.perf_counter() - t0
+                assert all(out)
+                rate_ha = max(rate_ha, len(items) / dt)
+        except Exception as e:  # the measured headline must survive
+            print(f"# bench: host-assist A/B failed: {e}", file=sys.stderr)
+        if rate_ha > rate:
+            rate = rate_ha
+            # the winning run was streams=1 + assist — the recorded knobs
+            # must describe a configuration that actually ran
+            streams_used = 1
+            _progress.update(rate=rate)
+    elif want_ha:
+        print(
+            "# bench: skipping host-assist A/B (<120s watchdog budget left)",
+            file=sys.stderr,
+        )
+
     result = {
         "batch": batch,
         "chunks": nchunks,
@@ -399,6 +437,10 @@ def _main():
         result["rate_1stream"] = round(best, 1)
         result["rate_2stream"] = round(rate_2s, 1)
         result["streams_used"] = streams_used
+    if rate_ha:
+        result["rate_host_assist"] = round(rate_ha, 1)
+        result["host_assist_frac"] = ha_frac
+        result["host_assist_used"] = rate == rate_ha
     _progress.update(stage="ledger-close", rate=rate)
     if os.environ.get("BENCH_SKIP_CLOSE", "0") != "1":
         n_close_txs = int(os.environ.get("BENCH_CLOSE_TXS", "5000"))

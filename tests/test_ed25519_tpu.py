@@ -226,6 +226,27 @@ class TestBatchVerifier:
         batch = [item] * 33
         assert bv.verify(batch) == [True] * 33
 
+    def test_host_assist_split_matches_full_device(self, bv):
+        """host_assist peels the batch tail onto a concurrent libsodium
+        loop; results must be identical to the all-device path for a mix
+        of valid and corrupted signatures."""
+        rng = random.Random(77)
+        items = []
+        for i in range(40):
+            sk = SecretKey.pseudo_random_for_testing(200 + i)
+            msg = b"assist %d" % i
+            sig = bytearray(sk.sign(msg))
+            if i % 3 == 0:
+                sig[rng.randrange(64)] ^= 1 << rng.randrange(8)
+            items.append((sk.public_raw, msg, bytes(sig)))
+        want = bv.verify(items)
+        ha = ed.BatchVerifier(
+            max_batch=64, min_device_batch=16, host_assist=0.4
+        )
+        got = ha.verify(items)
+        assert got == want
+        assert ha.n_host_assist_items == 16  # 0.4 * 40 peeled to host
+
     def test_empty_and_gate_only_batches(self, bv):
         assert bv.verify([]) == []
         # all items fail the host gate -> no device call needed
