@@ -221,6 +221,8 @@ class Peer:
 
         addrs: List[PeerAddress] = []
         for pr in PeerRecord.load_peers(self.app.database, 50, self.app.clock.now() + 3600):
+            if pr.is_private_address():
+                continue  # never advertise RFC1918 space (Peer.cpp:392)
             try:
                 parts = bytes(int(x) for x in pr.ip.split("."))
             except ValueError:
@@ -401,7 +403,14 @@ class Peer:
                 continue  # remote-supplied; don't let bad data near the DB
             ip = ".".join(str(b) for b in addr.ip.value)
             try:
-                pr = PeerRecord(ip, addr.port, self.app.clock.now(), addr.numFailures)
+                # numFailures deliberately NOT copied from the remote — we
+                # may have better luck, and remote data must not poison
+                # our backoff (Peer.cpp:1128-1141); private addresses are
+                # ignored outright
+                pr = PeerRecord(ip, addr.port, self.app.clock.now(), 0)
+                if pr.is_private_address():
+                    log.warning("ignoring received private address %s", pr.to_string())
+                    continue
                 pr.store(self.app.database)
             except Exception as e:
                 log.warning("could not store peer %s:%d: %s", ip, addr.port, e)

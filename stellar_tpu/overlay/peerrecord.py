@@ -33,6 +33,21 @@ class PeerRecord:
     def to_string(self) -> str:
         return f"{self.ip}:{self.port}"
 
+    def is_private_address(self) -> bool:
+        """RFC1918 check, exactly the reference's ranges
+        (PeerRecord.cpp:213-229): 10/8, 172.16/12, 192.168/16.  NOT
+        ipaddress.is_private — that also counts 127/8 and link-local,
+        and loopback/TCP tests legitimately exchange 127.0.0.1."""
+        try:
+            val = int(ipaddress.IPv4Address(self.ip))
+        except (ipaddress.AddressValueError, ValueError):
+            return False
+        return (
+            (val >> 24) == 10
+            or (val >> 20) == 2753
+            or (val >> 16) == 49320
+        )
+
     # -- SQL ---------------------------------------------------------------
     @staticmethod
     def drop_all(db) -> None:

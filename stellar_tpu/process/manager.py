@@ -24,11 +24,17 @@ class ProcessExitEvent:
     """Handle for a queued/running subprocess; ``on_exit(returncode)`` fires
     on the main loop when it finishes (0 = success)."""
 
-    __slots__ = ("cmdline", "on_exit", "live", "returncode")
+    __slots__ = ("cmdline", "on_exit", "out_file", "live", "returncode")
 
-    def __init__(self, cmdline: str, on_exit: Optional[Callable[[int], None]]):
+    def __init__(
+        self,
+        cmdline: str,
+        on_exit: Optional[Callable[[int], None]],
+        out_file: Optional[str] = None,
+    ):
         self.cmdline = cmdline
         self.on_exit = on_exit
+        self.out_file = out_file
         self.live = False
         self.returncode: Optional[int] = None
 
@@ -43,9 +49,15 @@ class ProcessManager:
         self._shutdown = False
 
     def run_process(
-        self, cmdline: str, on_exit: Optional[Callable[[int], None]] = None
+        self,
+        cmdline: str,
+        on_exit: Optional[Callable[[int], None]] = None,
+        out_file: Optional[str] = None,
     ) -> ProcessExitEvent:
-        ev = ProcessExitEvent(cmdline, on_exit)
+        """out_file redirects the child's stdout (the reference's
+        runProcess(cmd, outFile) overload, ProcessManagerImpl — history
+        archive `get` commands fetch into files this way)."""
+        ev = ProcessExitEvent(cmdline, on_exit, out_file)
         self.pending.append(ev)
         self._maybe_start()
         return ev
@@ -66,16 +78,24 @@ class ProcessManager:
         def work():
             if self._shutdown:
                 return -15  # shutdown raced the spawn: never start the child
+            out = subprocess.DEVNULL
             try:
+                if ev.out_file is not None:
+                    out = open(ev.out_file, "wb")
                 proc = subprocess.Popen(
                     ev.cmdline,
                     shell=True,
-                    stdout=subprocess.DEVNULL,
+                    stdout=out,
                     stderr=subprocess.DEVNULL,
                 )
             except OSError as e:
                 log.warning("spawn failed for %r: %s", ev.cmdline, e)
                 return 127
+            finally:
+                # Popen dup'd the fd (or we never opened one); the parent's
+                # handle can close either way
+                if out is not subprocess.DEVNULL and not out.closed:
+                    out.close()
             self._live_procs.add(proc)
             if self._shutdown:
                 # shutdown() ran between the check above and the spawn —

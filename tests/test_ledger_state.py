@@ -399,3 +399,55 @@ class TestReadonlyLoads:
         rw.account.balance = 7  # never stored
         again = AccountFrame.load_account(aid, db, readonly=True)
         assert again.get_balance() == 10**9
+
+
+class TestLedgerHeaderPersistence:
+    """LedgerHeaderTests.cpp:22-57 'ledgerheader': a closed ledger's header
+    survives an application restart from the same on-disk DB, and loads
+    back by hash and by sequence."""
+
+    def test_header_survives_restart(self, tmp_path):
+        from stellar_tpu.herder.ledgerclose import LedgerCloseData
+        from stellar_tpu.herder.txset import TxSetFrame
+        from stellar_tpu.ledger.headerframe import LedgerHeaderFrame
+        from stellar_tpu.main.application import Application
+        from stellar_tpu.tx import testutils as T
+        from stellar_tpu.util.clock import VirtualClock
+        from stellar_tpu.xdr.ledger import StellarValue
+
+        cfg = T.get_test_config(55)
+        cfg.DATABASE = f"sqlite3://{tmp_path}/header.db"
+
+        clock = VirtualClock()
+        app = Application.create(clock, cfg, new_db=True)
+        lm = app.ledger_manager
+        txset = TxSetFrame(lm.last_closed.hash)
+        sv = StellarValue(txset.get_contents_hash(), 1, [], 0)
+        lm.close_ledger(
+            LedgerCloseData(lm.current.header.ledgerSeq, txset, sv)
+        )
+        saved_hash = lm.last_closed.hash
+        saved_seq = lm.last_closed.header.ledgerSeq
+        app.graceful_stop()
+        clock.shutdown()
+
+        clock2 = VirtualClock()
+        cfg2 = T.get_test_config(55)
+        cfg2.DATABASE = f"sqlite3://{tmp_path}/header.db"
+        cfg2.FORCE_SCP = False
+        app2 = Application.create(clock2, cfg2, new_db=False)
+        try:
+            app2.start()  # loadLastKnownLedger
+            lcl = app2.ledger_manager.last_closed
+            assert lcl.hash == saved_hash
+            assert lcl.header.ledgerSeq == saved_seq
+
+            by_hash = LedgerHeaderFrame.load_by_hash(app2.database, saved_hash)
+            assert by_hash is not None
+            assert by_hash.get_hash() == saved_hash
+            by_seq = LedgerHeaderFrame.load_by_sequence(app2.database, saved_seq)
+            assert by_seq is not None
+            assert by_seq.get_hash() == saved_hash
+        finally:
+            app2.graceful_stop()
+            clock2.shutdown()

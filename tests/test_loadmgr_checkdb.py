@@ -28,6 +28,7 @@ def make_app(clock, instance=40):
 
 class TestCheckDB:
     def test_checkdb_ok_after_ledgers(self, clock):
+        """BucketTests.cpp:846-882 'checkdb succeeding'."""
         app = make_app(clock, 41)
         app.herder.bootstrap()
         lm = app.ledger_manager
@@ -120,6 +121,8 @@ class TestLoadManager:
         assert pc.time_spent > 0
 
     def test_shedding_drops_worst_peer(self, clock):
+        """OverlayTests.cpp:278-330 'disconnect peers when overloaded'
+        (LoadManager cost attribution picks the victim)."""
         app = make_app(clock, 44)
         app.config.MINIMUM_IDLE_PERCENT = 99
 

@@ -63,6 +63,7 @@ def two_apps():
 
 
 def test_loopback_handshake(two_apps):
+    """OverlayTests.cpp:34-47 'loopback peer hello' (+ authentication)."""
     clock, a, b = two_apps
     conn = LoopbackPeerConnection(a, b)
     crank(clock)
@@ -85,7 +86,8 @@ def test_handshake_rejects_wrong_network(two_apps):
 
 
 def test_handshake_rejects_damaged_cert(two_apps):
-    """OverlayTests.cpp:151 'reject peers with bad certs'."""
+    """OverlayTests.cpp:49-67 'failed auth' / OverlayTests.cpp:151 'reject
+    peers with invalid cert'."""
     clock, a, b = two_apps
     conn = LoopbackPeerConnection(a, b)
     conn.initiator.damage_cert = True
@@ -148,7 +150,9 @@ def test_floodgate_dedup(two_apps):
 
 
 def test_transaction_floods_between_nodes():
-    """FloodTests.cpp 'FloodTests': a tx submitted on A reaches B's queue."""
+    """FloodTests.cpp:25-120 'Flooding': a tx submitted on A reaches B's
+    queue (the SCP-envelope flood half runs in every consensus round of
+    test_simulation.py's multi-node suites)."""
     clock = VirtualClock()
     a = make_app(clock, 0)
     b = make_app(clock, 1)
@@ -182,18 +186,21 @@ def test_get_peers_exchange(two_apps):
     clock, a, b = two_apps
     from stellar_tpu.overlay import PeerRecord
 
-    PeerRecord("10.1.2.3", 12345).store(b.database)
+    # must be a PUBLIC address: private space is filtered from peer
+    # exchange in both directions (Peer.cpp:392, :1128-1141)
+    PeerRecord("44.1.2.3", 12345).store(b.database)
     conn = LoopbackPeerConnection(a, b)
     crank(clock)
     conn.initiator.send_get_peers()
     crank(clock)
-    assert PeerRecord.load(a.database, "10.1.2.3", 12345) is not None
+    assert PeerRecord.load(a.database, "44.1.2.3", 12345) is not None
 
 
 # -- item fetch ------------------------------------------------------------
 
 
 def test_item_fetcher_anycast(two_apps):
+    """ItemFetcherTests.cpp:22-100 'ItemFetcher fetches'."""
     clock, a, b = two_apps
     conn = LoopbackPeerConnection(a, b)
     crank(clock)
@@ -242,7 +249,8 @@ def test_fetch_timeout_retries(two_apps):
 
 
 def test_tcp_handshake_over_real_sockets():
-    """OverlayTests OVER_TCP flavor: PeerDoor accept + TCPPeer.initiate."""
+    """TCPPeerTests.cpp:19-66 'TCPPeer can communicate' (OverlayTests
+    OVER_TCP flavor: PeerDoor accept + TCPPeer.initiate)."""
     from stellar_tpu.overlay import PeerRecord
 
     clock = VirtualClock()
@@ -348,3 +356,120 @@ def test_reject_incompatible_overlay_version(two_apps):
     crank(clock)
     assert not conn.initiator.is_authenticated()
     assert not conn.acceptor.is_authenticated()
+
+
+def test_reject_peers_with_same_nodeid():
+    """OverlayTests.cpp:231-256 'reject peers with the same nodeid': a second
+    connection claiming an already-connected node identity is dropped during
+    the handshake ("already connected", peer.py recv_hello2)."""
+    clock = VirtualClock()
+    a1 = make_app(clock, 0)
+    a2 = make_app(clock, 1)
+    cfg3 = T.get_test_config(2)
+    cfg3.MANUAL_CLOSE = True
+    cfg3.RUN_STANDALONE = True
+    cfg3.HTTP_PORT = 0
+    cfg3.NODE_SEED = a1.config.NODE_SEED  # impersonates a1
+    from stellar_tpu.xdr.scp import SCPQuorumSet
+
+    cfg3.QUORUM_SET = SCPQuorumSet(1, [cfg3.NODE_SEED.get_public_key()], [])
+    a3 = Application.create(clock, cfg3, new_db=True)
+    a3.start()
+    try:
+        conn = LoopbackPeerConnection(a1, a2)
+        crank(clock)
+        assert conn.initiator.is_authenticated()
+        assert conn.acceptor.is_authenticated()
+        conn2 = LoopbackPeerConnection(a3, a2)
+        crank(clock)
+        assert not conn2.initiator.is_authenticated()
+        assert not conn2.acceptor.is_authenticated()
+        assert a2.overlay_manager.get_authenticated_peer_count() == 1
+    finally:
+        a1.graceful_stop()
+        a2.graceful_stop()
+        a3.graceful_stop()
+
+
+class TestPeerRecord:
+    """PeerRecordTests.cpp:18-84."""
+
+    def _db(self):
+        from stellar_tpu.database.database import Database
+        from stellar_tpu.overlay import PeerRecord
+
+        db = Database("sqlite3://:memory:")
+        PeerRecord.drop_all(db)
+        return db
+
+    def test_parse_store_load_roundtrip(self):
+        """PeerRecordTests.cpp:18-69 'toXdr' (parse + insert-if-new +
+        store/load semantics; the wire half is send_peers' PeerAddress)."""
+        from stellar_tpu.overlay import PeerRecord
+
+        db = self._db()
+        pr = PeerRecord.parse_ip_port("1.25.50.200:256")
+        assert (pr.ip, pr.port) == ("1.25.50.200", 256)
+        pr.num_failures = 2
+        pr.next_attempt = 12.0
+        assert pr.store(db) is True  # newly inserted
+
+        # second insert of the same (ip, port) is an update, not new
+        pr2 = PeerRecord("1.25.50.200", 256, 24.0, 3)
+        assert pr2.store(db) is False
+        got = PeerRecord.load(db, "1.25.50.200", 256)
+        assert (got.next_attempt, got.num_failures) == (24.0, 3)
+
+        other = PeerRecord("1.2.3.4", 15, 0.0)
+        other.store(db)
+        assert PeerRecord.load(db, "1.2.3.4", 15).port == 15
+
+    def test_private_addresses(self):
+        """PeerRecordTests.cpp:71-84 'private addresses'."""
+        from stellar_tpu.overlay import PeerRecord
+
+        assert not PeerRecord("1.2.3.4", 15).is_private_address()
+        assert PeerRecord("10.1.2.3", 15).is_private_address()
+        assert PeerRecord("172.17.1.2", 15).is_private_address()
+        assert PeerRecord("192.168.1.2", 15).is_private_address()
+        # boundaries of the 172.16/12 block
+        assert PeerRecord("172.15.1.2", 15).is_private_address() is False
+        assert PeerRecord("172.16.0.1", 15).is_private_address() is True
+        assert PeerRecord("172.31.255.1", 15).is_private_address() is True
+        assert PeerRecord("172.32.0.1", 15).is_private_address() is False
+        # loopback is NOT in the reference's private set
+        assert not PeerRecord("127.0.0.1", 15).is_private_address()
+
+
+def test_private_addresses_not_exchanged(two_apps):
+    """Peer.cpp:392 (never advertise private addresses) and
+    Peer.cpp:1128-1141 (ignore received ones; never copy the remote's
+    numFailures)."""
+    from stellar_tpu.overlay import PeerRecord
+    from stellar_tpu.xdr.overlay import IPAddrType, PeerAddress, PeerAddressIp
+
+    clock, a, b = two_apps
+    conn = LoopbackPeerConnection(a, b)
+    crank(clock)
+    assert conn.initiator.is_authenticated()
+
+    # a advertises: one public, one private -> only the public one is sent
+    PeerRecord("10.1.2.3", 11111, 0.0).store(a.database)
+    PeerRecord("8.8.4.4", 22222, 0.0).store(a.database)
+    conn.initiator.send_peers()
+    crank(clock)
+    assert PeerRecord.load(b.database, "8.8.4.4", 22222) is not None
+    assert PeerRecord.load(b.database, "10.1.2.3", 11111) is None
+
+    # received private addresses are ignored; numFailures never copied
+    msg = StellarMessage(
+        MessageType.PEERS,
+        [
+            PeerAddress(PeerAddressIp(IPAddrType.IPv4, bytes([192, 168, 0, 9])), 1, 0),
+            PeerAddress(PeerAddressIp(IPAddrType.IPv4, bytes([9, 9, 9, 9])), 2, 7),
+        ],
+    )
+    conn.initiator.recv_peers(msg)
+    assert PeerRecord.load(a.database, "192.168.0.9", 1) is None
+    stored = PeerRecord.load(a.database, "9.9.9.9", 2)
+    assert stored is not None and stored.num_failures == 0

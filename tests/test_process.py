@@ -35,6 +35,8 @@ def crank_until(clock, pred, seconds=10.0):
 
 
 def test_success_and_failure_exit_codes(app):
+    """ProcessTests.cpp:20-45 'subprocess' / ProcessTests.cpp:47-72
+    'subprocess fails'."""
     pm = ProcessManager(app)
     codes = {}
     pm.run_process("true", lambda rc: codes.__setitem__("ok", rc))
@@ -85,3 +87,40 @@ def test_shutdown_clears_pending_and_kills_live(app):
     # fire for it, but nothing hangs and no queued work starts
     crank_until(app.clock, lambda: pm.get_num_running() == 0, seconds=5)
     assert pm.get_num_running() == 0
+
+
+def test_redirect_stdout_to_file(app, tmp_path):
+    """ProcessTests.cpp:74-106 'subprocess redirect to file'."""
+    out = tmp_path / "hostname.txt"
+    pm = ProcessManager(app)
+    done = []
+    pm.run_process(
+        "hostname", on_exit=lambda rc: done.append(rc), out_file=str(out)
+    )
+    assert crank_until(app.clock, lambda: done)
+    assert done == [0]
+    assert out.read_text().strip() != ""
+
+
+def test_subprocess_storm(app, tmp_path):
+    """ProcessTests.cpp:108-160 'subprocess storm': 100 short-lived mv
+    children, all completing, never exceeding the concurrency cap."""
+    src = tmp_path / "src"
+    dst = tmp_path / "dst"
+    src.mkdir()
+    dst.mkdir()
+    n = 100
+    pm = ProcessManager(app)
+    completed = []
+    peak = []
+    for i in range(n):
+        (src / str(i)).write_text(str(i))
+        pm.run_process(
+            f"mv {src}/{i} {dst}/{i}", on_exit=lambda rc: completed.append(rc)
+        )
+        peak.append(pm.get_num_running())
+    assert max(peak) <= app.config.MAX_CONCURRENT_SUBPROCESSES
+    assert crank_until(app.clock, lambda: len(completed) == n, seconds=60)
+    assert all(rc == 0 for rc in completed)
+    assert sorted(int(p.name) for p in dst.iterdir()) == list(range(n))
+    assert not list(src.iterdir())

@@ -144,6 +144,27 @@ class TestQuorumMath:
         q = SCPQuorumSet(threshold=0, validators=[], innerSets=[])
         assert not quorum.is_v_blocking(q, set(NODES))
 
+    def test_nomination_weight(self):
+        """SCPUnitTests.cpp:14-46 'nomination weight': node_weight is the
+        /2^64 fixed-point probability of appearing in a sampled slice —
+        threshold/size down the first branch containing the node."""
+        from stellar_tpu.scp.quorum import UINT64_MAX, node_weight
+
+        def near(got, frac):
+            return abs(got / UINT64_MAX - frac) < 0.01
+
+        q = SCPQuorumSet(threshold=3, validators=NODES[:4], innerSets=[])
+        assert near(node_weight(NODES[2], q), 0.75)
+        assert node_weight(NODES[4], q) == 0
+
+        v5 = SecretKey.pseudo_random_for_testing(5).get_public_key()
+        inner = SCPQuorumSet(
+            threshold=1, validators=[NODES[4], v5], innerSets=[]
+        )
+        q = SCPQuorumSet(threshold=3, validators=NODES[:4], innerSets=[inner])
+        # 5 entries, threshold 3; inner picks v4 with prob 1/2
+        assert near(node_weight(NODES[4], q), 0.6 * 0.5)
+
     def test_nested(self):
         inner = SCPQuorumSet(threshold=2, validators=NODES[2:5], innerSets=[])
         q = SCPQuorumSet(threshold=2, validators=NODES[:2], innerSets=[inner])

@@ -66,7 +66,8 @@ def test_three_nodes_tpu_backend_externalize():
 
 
 def test_core_topology_4_ledgers():
-    """CoreTests.cpp:104 at scales 2..4."""
+    """CoreTests.cpp:104 at scales 2..4 (+ CoreTests.cpp:209-223 'core-nodes
+    with outer nodes' — hierarchical_quorum_simplified below runs core+outer)."""
     for n in (2, 3, 4):
         run_sim(topologies.core(n), 4)
 
@@ -76,10 +77,14 @@ def test_core2_over_tcp():
 
 
 def test_cycle4():
+    """CoreTests.cpp:225-240 'cycle4 topology'."""
     run_sim(topologies.cycle4(), 2, timeout=240)
 
 
 def test_hierarchical_quorum():
+    """CoreTests.cpp:161-207 'hierarchical topology scales 1..3' /
+    CoreTests.cpp:209-223 'core-nodes with outer nodes' (simplified
+    tier)."""
     sim = topologies.hierarchical_quorum_simplified(core_n=3, outer_n=1)
     sim.start_all_nodes()
     ok = sim.crank_until(lambda: sim.have_all_externalized(2), 240)

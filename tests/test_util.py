@@ -21,6 +21,8 @@ from stellar_tpu.util import (
 
 class TestVirtualClock:
     def test_virtual_time_advances_to_deadlines(self):
+        """TimerTests.cpp:86-143 'virtual event dispatch order and times'
+        (deadline-ordered dispatch; the exact-time half is below)."""
         clock = VirtualClock(VIRTUAL_TIME)
         fired = []
         for delay in (5.0, 1.0, 3.0):
@@ -46,6 +48,7 @@ class TestVirtualClock:
         clock.shutdown()
 
     def test_cancel_fires_on_cancel_not_trigger(self):
+        """TimerTests.cpp:209-257 'timer cancels'."""
         clock = VirtualClock(VIRTUAL_TIME)
         events = []
         t = VirtualTimer(clock)
@@ -56,6 +59,42 @@ class TestVirtualClock:
             pass
         assert events == ["cancelled"]
         assert clock.now() == 0.0  # cancelled timer must not advance time
+        clock.shutdown()
+
+    def test_dispatch_times_are_exact(self):
+        """TimerTests.cpp:86-143, exact-time half: each handler observes
+        now() == its own deadline — the clock advances to, never past."""
+        clock = VirtualClock(VIRTUAL_TIME)
+        seen = []
+        for ms in (0.001, 0.020, 0.021, 0.200):
+            t = VirtualTimer(clock)
+            t.expires_from_now(ms)
+            t.async_wait(lambda m=ms: seen.append((m, clock.now())))
+        while clock.crank():
+            pass
+        assert seen == [(m, m) for m in (0.001, 0.020, 0.021, 0.200)]
+        clock.shutdown()
+
+    def test_shared_clock_two_services_advance_when_both_idle(self):
+        """TimerTests.cpp:145-207 'shared virtual time advances only when
+        all apps idle': two services on ONE clock; time only jumps to the
+        next deadline across both, so their timers interleave on the
+        shared timeline instead of one service racing ahead."""
+        clock = VirtualClock(VIRTUAL_TIME)
+        log = []
+        def arm(tag, delay, n):
+            if n == 0:
+                return
+            t = VirtualTimer(clock)
+            t.expires_from_now(delay)
+            t.async_wait(lambda: (log.append((tag, clock.now())),
+                                  arm(tag, delay, n - 1)))
+        arm("a", 0.3, 3)   # a fires at .3 .6 .9
+        arm("b", 0.2, 4)   # b fires at .2 .4 .6 .8
+        while clock.crank():
+            pass
+        assert log == sorted(log, key=lambda e: e[1])
+        assert [t for t, _ in log] == ["b", "a", "b", "a", "b", "b", "a"]
         clock.shutdown()
 
     def test_timer_rearm(self):
