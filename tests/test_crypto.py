@@ -255,8 +255,7 @@ class TestEcdh:
 
 class TestBase58:
     """CryptoTests.cpp:190-242 'base58 tests' / CryptoTests.cpp:244-274
-    'base58check tests'."""
-    """Reference vectors from /root/reference/src/crypto/CryptoTests.cpp:137-189."""
+    'base58check tests'; reference vectors from CryptoTests.cpp:137-189."""
 
     VECTORS = [
         (bytes([97] * 32), "7Z8ftDAzMvoyXnGEJye8DurzgQQXLAbYCaeeesM7UKHa"),

@@ -214,10 +214,10 @@ def test_scp_envelopes_coalesce_into_one_sig_batch(clock):
 @pytest.mark.parametrize("backend", ["cpu", "tpu"])
 def test_sustained_envelope_stress_with_batch_verify(clock, backend):
     """CoreTests.cpp:242-292 '[stress100]'-class sustained random-traffic
-    stress, the repo's deterministic flavor."""
-    """1000 foreign envelopes pre-verified through the SigBackend batch
-    path (the overlay's recv_scp_batch pattern), then fed to the herder —
-    bit-identical accept/reject decisions, node stays synced."""
+    stress, the repo's deterministic flavor: 1000 foreign envelopes
+    pre-verified through the SigBackend batch path (the overlay's
+    recv_scp_batch pattern), then fed to the herder — bit-identical
+    accept/reject decisions, node stays synced."""
     app = make_app(clock, 73, backend=backend)
     h = app.herder
     lm = app.ledger_manager
