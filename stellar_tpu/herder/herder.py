@@ -519,7 +519,7 @@ class Herder(SCPDriver):
     # transaction queue
     # ------------------------------------------------------------------
     def recv_transaction(self, tx) -> str:
-        acc = tx.get_source_id().value
+        acc = tx.source_bytes()
         tx_id = tx.get_full_hash()
 
         tot_fee = tx.get_fee()
@@ -564,7 +564,7 @@ class Herder(SCPDriver):
                 continue
             dirty = set()
             for tx in drop_txs:
-                acc = tx.get_source_id().value
+                acc = tx.source_bytes()
                 txmap = gen.get(acc)
                 if txmap is None:
                     continue

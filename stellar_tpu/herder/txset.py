@@ -85,11 +85,11 @@ class TxSetFrame:
         batches: List[List[TransactionFrame]] = [[] for _ in range(4)]
         seen_count: Dict[bytes, int] = {}
         for tx in txs:
-            v = seen_count.get(tx.get_source_id().value, 0)
+            v = seen_count.get(tx.source_bytes(), 0)
             if v >= len(batches):
                 batches.extend([] for _ in range(4))
             batches[v].append(tx)
-            seen_count[tx.get_source_id().value] = v + 1
+            seen_count[tx.source_bytes()] = v + 1
 
         # lessThanXored(l, r, x) is a lexicographic compare of l^x vs r^x,
         # which equals comparing the big-endian integers (l^x) < (r^x) —
@@ -194,7 +194,7 @@ class TxSetFrame:
     def _account_tx_map(self) -> Dict[bytes, List[TransactionFrame]]:
         m: Dict[bytes, List[TransactionFrame]] = {}
         for tx in self.transactions:
-            m.setdefault(tx.get_source_id().value, []).append(tx)
+            m.setdefault(tx.source_bytes(), []).append(tx)
         return m
 
     @staticmethod
@@ -268,15 +268,15 @@ class TxSetFrame:
         account_fee: Dict[bytes, float] = {}
         for tx in self.transactions:
             r = tx.get_fee() / tx.get_min_fee(lm)
-            cur = account_fee.get(tx.get_source_id().value, 0.0)
+            cur = account_fee.get(tx.source_bytes(), 0.0)
             if cur == 0 or r < cur:
-                account_fee[tx.get_source_id().value] = r
+                account_fee[tx.source_bytes()] = r
 
         def surge_key(tx):
             # higher fee ratio first; ties by account id; within an account by seq
             return (
-                -account_fee[tx.get_source_id().value],
-                tx.get_source_id().value,
+                -account_fee[tx.source_bytes()],
+                tx.source_bytes(),
                 tx.get_seq_num(),
             )
 
