@@ -132,7 +132,7 @@ def test_catchup_complete_replays_history(clock, fresh_archive):
         lm2 = app2.ledger_manager
         lm2.start_catchup()
         assert clock.crank_until(
-            lambda: lm2.state == LedgerState.LM_SYNCED_STATE, 60
+            lambda: lm2.state == LedgerState.LM_SYNCED_STATE, 180
         )
         assert lm2.get_last_closed_ledger_num() == FREQ - 1
         # full replay: exact same chain...
@@ -160,7 +160,7 @@ def test_catchup_minimal_adopts_buckets(clock, fresh_archive):
         lm2 = app2.ledger_manager
         lm2.start_catchup()
         assert clock.crank_until(
-            lambda: lm2.state == LedgerState.LM_SYNCED_STATE, 60
+            lambda: lm2.state == LedgerState.LM_SYNCED_STATE, 180
         )
         assert lm2.get_last_closed_ledger_num() == FREQ - 1
         assert lm2.last_closed.hash == lcl1.hash
@@ -215,7 +215,7 @@ def test_second_checkpoint_and_catchup_across_two(clock, fresh_archive):
         lm2 = app2.ledger_manager
         lm2.start_catchup()
         assert clock.crank_until(
-            lambda: lm2.state == LedgerState.LM_SYNCED_STATE, 60
+            lambda: lm2.state == LedgerState.LM_SYNCED_STATE, 180
         )
         assert lm2.get_last_closed_ledger_num() == 2 * FREQ - 1
         assert lm2.last_closed.hash == lcl1.hash
@@ -447,7 +447,7 @@ class _ObjectStore:
 _S3GET = (
     "import sys, urllib.request\n"
     "url, local = sys.argv[1], sys.argv[2]\n"
-    "data = urllib.request.urlopen(url, timeout=10).read()\n"
+    "data = urllib.request.urlopen(url, timeout=30).read()\n"
     "open(local, 'wb').write(data)\n"
 )
 _S3PUT = (
@@ -455,7 +455,7 @@ _S3PUT = (
     "local, url = sys.argv[1], sys.argv[2]\n"
     "req = urllib.request.Request(\n"
     "    url, data=open(local, 'rb').read(), method='PUT')\n"
-    "urllib.request.urlopen(req, timeout=10).read()\n"
+    "urllib.request.urlopen(req, timeout=30).read()\n"
 )
 
 
@@ -505,7 +505,7 @@ def test_publish_catchup_via_s3_style_object_store(clock, tmp_path):
             lm2 = app2.ledger_manager
             lm2.start_catchup()
             assert clock.crank_until(
-                lambda: lm2.state == LedgerState.LM_SYNCED_STATE, 60
+                lambda: lm2.state == LedgerState.LM_SYNCED_STATE, 180
             )
             assert lm2.last_closed.hash == lcl1.hash
             for dest in made:
