@@ -16,7 +16,7 @@ import time
 import numpy as np
 
 
-def main(batch=32768):
+def main(batch=32768, ab=False):
     import jax
     import jax.numpy as jnp
 
@@ -51,22 +51,43 @@ def main(batch=32768):
 
     from stellar_tpu.ops.ed25519_pallas import verify_kernel_pallas
 
-    ok = verify_kernel_pallas(a_b, r_b, s_b, h_b)  # compile
-    ok.block_until_ready()
-    assert bool(np.asarray(ok).all()), "profile signatures must verify"
-    times = []
-    for _ in range(5):
-        t0 = time.perf_counter()
-        verify_kernel_pallas(a_b, r_b, s_b, h_b).block_until_ready()
-        times.append(time.perf_counter() - t0)
-    best = min(times)
-    net = best - rtt
-    print(
-        f"batch {batch}: kernel call best {best * 1e3:.1f} ms "
-        f"(rtt {rtt * 1e3:.1f} ms) -> net {net * 1e3:.1f} ms = "
-        f"{batch / net:,.0f} verifies/s device-only"
-    )
+    def leg(signed):
+        ok = verify_kernel_pallas(a_b, r_b, s_b, h_b, signed=signed)
+        ok.block_until_ready()  # compile
+        assert bool(np.asarray(ok).all()), "profile signatures must verify"
+        times = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            verify_kernel_pallas(
+                a_b, r_b, s_b, h_b, signed=signed
+            ).block_until_ready()
+            times.append(time.perf_counter() - t0)
+        best = min(times)
+        net = best - rtt
+        tag = "signed-window" if signed else "unsigned"
+        print(
+            f"batch {batch} [{tag}]: kernel call best {best * 1e3:.1f} ms "
+            f"(rtt {rtt * 1e3:.1f} ms) -> net {net * 1e3:.1f} ms = "
+            f"{batch / net:,.0f} verifies/s device-only",
+            flush=True,
+        )
+        return net
+
+    if ab:
+        # same-process same-window A/B/A (cross-window absolutes are
+        # confounded — PROFILE.md); order off/on/off controls drift
+        off1 = leg(False)
+        on = leg(True)
+        off2 = leg(False)
+        gain = 1.0 - on / min(off1, off2)
+        print(f"signed-window gain vs best unsigned leg: {gain:+.1%}")
+    else:
+        leg(None)
 
 
 if __name__ == "__main__":
-    main(int(sys.argv[1]) if len(sys.argv) > 1 else 32768)
+    args = [a for a in sys.argv[1:] if a != "--ab"]
+    main(
+        int(args[0]) if args else 32768,
+        ab="--ab" in sys.argv,
+    )

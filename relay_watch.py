@@ -43,6 +43,9 @@ ACTIVE_FLAG = "/tmp/relay_window_active"  # advisory: a step is running
 SCRIPT_STEPS = [
     ("kernel", [sys.executable, "-u", "profile_kernel.py"], 900),
     ("overlap", [sys.executable, "-u", "probe_overlap.py"], 700),
+    # round-5 signed-digit window experiment: same-window off/on/off legs
+    # in one process (two compiles, so the budget is generous)
+    ("kernel_signed_ab", [sys.executable, "-u", "profile_kernel.py", "--ab"], 1400),
 ]
 CLOSE_STEPS = [
     # (name, n_txs, backend, timeout); cpu legs listed after their pair

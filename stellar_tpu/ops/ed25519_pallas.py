@@ -45,6 +45,13 @@ NT = 512  # batch tile (lanes); must divide the padded batch
 # absolute comparisons are confounded by window quality (PROFILE.md).
 _BATCH_INV = os.environ.get("STELLAR_TPU_BATCH_INV", "1") != "0"
 
+# Signed-digit windows (round-5 experiment): recode the radix-16 scalar
+# digits to [-8, 7] with carry, so both niels tables need only k = 1..8
+# (half the dynamic-table build, ~half the select where-chains; the sign
+# is applied at select time — a niels negation is one component swap plus
+# one field negation).  Env-switchable for the same-window device A/B.
+_SIGNED_WIN = os.environ.get("STELLAR_TPU_SIGNED_WINDOWS", "0") != "0"
+
 _CONST_NAMES = ("SUB_PAD", "P_COL", "D", "D2", "SQRT_M1")
 
 
@@ -65,8 +72,29 @@ def _select_niels(tab_ref, nib):
     return tuple(comps)
 
 
+def _select_niels_signed(tab_ref, d):
+    """Signed-digit select: the table holds k·P (niels) for k = 1..8 and
+    ``d`` ∈ [-8, 7]; |d| picks the entry, d < 0 negates it (x → −x in
+    niels form: swap Y+X ↔ Y−X, negate T·2d, Z unchanged)."""
+    k = jnp.abs(d)
+    comps = list(_niels_identity(d.shape[0]))
+    for kk in range(1, 9):
+        mask = (k == kk)[None, :]
+        for c in range(4):
+            comps[c] = jnp.where(mask, tab_ref[c, kk], comps[c])
+    yp, ym, t2d, z2 = comps
+    negm = (d < 0)[None, :]
+    return (
+        jnp.where(negm, ym, yp),
+        jnp.where(negm, yp, ym),
+        jnp.where(negm, fe.neg(t2d), t2d),
+        z2,
+    )
+
+
 def _kernel(
-    const_ref, base_ref, a_ref, r_ref, s_ref, h_ref, out_ref, tab_ref, nib_ref
+    const_ref, base_ref, a_ref, r_ref, s_ref, h_ref, out_ref, tab_ref,
+    nib_ref, *, signed,
 ):
     override = {
         name: const_ref[i] for i, name in enumerate(_CONST_NAMES)
@@ -82,13 +110,15 @@ def _kernel(
         a_pt, fail = ed.decompress(a_y_limbs, a_sign)
         neg_a = ed.point_negate(a_pt)
 
-        # dynamic table: k * (-A) for k = 1..15, niels form, into VMEM scratch
+        # dynamic table: k * (-A), niels form, into VMEM scratch —
+        # k = 1..15 unsigned, only 1..8 signed (the select negates)
+        top = 9 if signed else 16
         pt = neg_a
-        for k in range(1, 16):
+        for k in range(1, top):
             niels = ed.to_niels(pt)
             for c in range(4):
                 tab_ref[c, k] = niels[c]
-            if k < 15:
+            if k < top - 1:
                 pt = ed.point_add(pt, neg_a)
 
         n = a_bytes.shape[1]
@@ -105,15 +135,29 @@ def _kernel(
             nib_ref[1, 2 * j] = hb & 0xF
             nib_ref[1, 2 * j + 1] = hb >> 4
 
+        if signed:
+            # recode digits to [-8, 7] with carry; both scalars are < L
+            # < 2^253 (strict gate / host mod-L — the verify_kernel_pallas
+            # docstring's stated precondition), so the top nibble is at
+            # most 1 and the final carry can never overflow window 63
+            for plane in range(2):
+                carry = jnp.zeros((n,), jnp.int32)
+                for t in range(64):
+                    d = nib_ref[plane, t] + carry
+                    carry = (d >= 8).astype(jnp.int32)
+                    nib_ref[plane, t] = d - (carry << 4)
+
+        sel = _select_niels_signed if signed else _select_niels
+
         def body(i, acc):
             t = ed.WINDOWS - 1 - i
             for k in range(4):
                 acc = ed.point_double(acc, need_t=(k == 3))
             s_nib = nib_ref[0, t]
             h_nib = nib_ref[1, t]
-            acc = ed.point_add_niels(acc, _select_niels(base_ref, s_nib))
+            acc = ed.point_add_niels(acc, sel(base_ref, s_nib))
             acc = ed.point_add_niels(
-                acc, _select_niels(tab_ref, h_nib), need_t=False
+                acc, sel(tab_ref, h_nib), need_t=False
             )
             return acc
 
@@ -123,12 +167,26 @@ def _kernel(
         out_ref[:] = (match & ~fail)[None]
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def verify_kernel_pallas(a_bytes, r_bytes, s_bytes, h_bytes, interpret=False):
+@functools.partial(jax.jit, static_argnames=("interpret", "signed"))
+def verify_kernel_pallas(
+    a_bytes, r_bytes, s_bytes, h_bytes, interpret=False, signed=None
+):
     """Same math/result as ops/ed25519.verify_kernel, but the four inputs
     are raw (32, N) uint8 byte columns (A, R, s, h=SHA-512(R‖A‖M) mod L,
     all little-endian) — 8x less host->device transfer than the XLA
-    kernel's int32+nibble interface.  N must be a multiple of NT."""
+    kernel's int32+nibble interface.  N must be a multiple of NT.
+    ``signed`` picks the signed-digit window variant (default: the
+    STELLAR_TPU_SIGNED_WINDOWS env flag).  PRECONDITION for equivalence:
+    s and h < 2^253 — i.e. gate-canonical s (strict_input_ok_batch
+    rejects s >= L, exactly libsodium's rule) and host-reduced h.  Every
+    BatchVerifier path guarantees this; a RAW caller feeding an ungated
+    s in [8L, 2^256) would see the unsigned kernel accept via the modular
+    identity while the signed recode drops its window-63 carry and
+    rejects — neither answer is consensus-reachable because the composed
+    verifier (gate + kernel) rejects such s before dispatch either way."""
+    if signed is None:
+        signed = _SIGNED_WIN
+    tabn = 9 if signed else 16
     n = a_bytes.shape[1]
     assert n % NT == 0, f"batch {n} not a multiple of tile {NT}"
     grid = n // NT
@@ -145,10 +203,10 @@ def verify_kernel_pallas(a_bytes, r_bytes, s_bytes, h_bytes, interpret=False):
         ]
     )  # (5, 20, NT)
     base_tab = jnp.broadcast_to(
-        ed._BASE_TABLE[..., None], (4, 16, fe.LIMBS, NT)
+        ed._BASE_TABLE[:, :tabn, :, None], (4, tabn, fe.LIMBS, NT)
     )  # static niels table of k*B, lane-replicated for Mosaic
     return pl.pallas_call(
-        _kernel,
+        functools.partial(_kernel, signed=signed),
         grid=(grid,),
         in_specs=[
             pl.BlockSpec(
@@ -156,7 +214,7 @@ def verify_kernel_pallas(a_bytes, r_bytes, s_bytes, h_bytes, interpret=False):
                 memory_space=pltpu.VMEM,
             ),
             pl.BlockSpec(
-                (4, 16, fe.LIMBS, NT), lambda i: (0, 0, 0, 0),
+                (4, tabn, fe.LIMBS, NT), lambda i: (0, 0, 0, 0),
                 memory_space=pltpu.VMEM,
             ),
             pl.BlockSpec((32, NT), lambda i: (0, i), memory_space=pltpu.VMEM),
@@ -169,7 +227,7 @@ def verify_kernel_pallas(a_bytes, r_bytes, s_bytes, h_bytes, interpret=False):
         ),
         out_shape=jax.ShapeDtypeStruct((1, n), jnp.bool_),
         scratch_shapes=[
-            pltpu.VMEM((4, 16, fe.LIMBS, NT), jnp.int32),
+            pltpu.VMEM((4, tabn, fe.LIMBS, NT), jnp.int32),
             pltpu.VMEM((2, 64, NT), jnp.int32),
         ],
         interpret=interpret,

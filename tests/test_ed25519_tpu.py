@@ -306,6 +306,11 @@ class TestPallasKernel:
         got = np.asarray(verify_kernel_pallas(*pallas_args, interpret=True))
         assert want.sum() > 0 and (~want).sum() > 0  # both classes present
         assert (want == got).all()
+        # signed-digit window variant: identical results on the same tile
+        got_signed = np.asarray(
+            verify_kernel_pallas(*pallas_args, interpret=True, signed=True)
+        )
+        assert (want == got_signed).all()
 
     def test_batch_gate_matches_scalar_gate(self):
         """strict_input_ok_batch must accept exactly what strict_input_ok
