@@ -22,6 +22,14 @@ from typing import Any, Iterable, List, Optional, Tuple
 SCHEMA_VERSION = 1
 
 
+class UnrollbackableWrite(RuntimeError):
+    """Rows were written inside a savepoint-less buffered transaction scope
+    that is now rolling back (or being retro-materialized) — the SQL plane
+    can no longer be unwound in lockstep with the store buffer.  Ledger
+    close must ABORT on this, never swallow it into txINTERNAL_ERROR: the
+    DB state is unknown (LedgerManager._apply_transactions re-raises)."""
+
+
 class Database:
     def __init__(self, connection_string: str = "sqlite3://:memory:", metrics=None):
         self.connection_string = connection_string
@@ -33,6 +41,7 @@ class Database:
         self._metrics = metrics
         self._tx_depth = 0
         self._sp_counter = 0
+        self._lazy_sps = []  # one slot per open buffered scope; see transaction()
         self.excluded_time = 0.0  # DBTimeExcluder support
         self.query_count = 0
         self.closed = False
@@ -93,34 +102,97 @@ class Database:
                 self._tx_depth -= 1
                 self._conn.execute("COMMIT")
         else:
-            self._sp_counter += 1
-            sp = f"sp_{self._sp_counter}"
             # the write-back entry store buffer (ledger/storebuffer.py)
             # mirrors the savepoint stack: buffered entry writes unwind in
-            # lockstep with the (row-less) SQL savepoint.  Only savepoints
-            # opened while the buffer is active get a mark — the enclosing
-            # BEGIN predates activation and unwinds via buffer.deactivate()
+            # lockstep with the SQL savepoint.  Only savepoints opened
+            # while the buffer is active get a mark — the enclosing BEGIN
+            # predates activation and unwinds via buffer.deactivate()
             buf = getattr(self, "_store_buffer", None)
             if buf is not None and not buf.active:
                 buf = None
-            self._conn.execute(f"SAVEPOINT {sp}")
             if buf is not None:
+                # Buffered mode: entry stores accumulate in the overlay
+                # and history rows land at close end, so this scope wraps
+                # ZERO SQL writes in the common case — the marks alone
+                # carry the undo and the per-tx SAVEPOINT/RELEASE round-
+                # trips (2 statements/tx at close) are dropped.  The ONE
+                # in-scope SQL writer (EntryStoreBuffer.flush_through, the
+                # inflation aggregate) first calls materialize_savepoints,
+                # which retro-opens real savepoints for every open lazy
+                # scope so its rows roll back exactly as before.
+                # Equivalence with write-through is pinned by the
+                # storebuffer differential suite (identical ledger hashes
+                # AND identical SQL dumps) + PARANOID_MODE; total_changes
+                # guards against an unmaterialized direct write — a
+                # rolled-back scope that wrote rows without a savepoint
+                # cannot be undone, so escalate instead of corrupting.
                 buf.push_mark()
+                self._lazy_sps.append([None, self._conn.total_changes])
+                self._tx_depth += 1
+                try:
+                    yield self
+                except BaseException as e:
+                    self._tx_depth -= 1
+                    buf.rollback_mark()
+                    sp, changes0 = self._lazy_sps.pop()
+                    if sp is not None:
+                        self._conn.execute(f"ROLLBACK TO SAVEPOINT {sp}")
+                        self._conn.execute(f"RELEASE SAVEPOINT {sp}")
+                    elif self._conn.total_changes != changes0:
+                        # chain the original error — it may be the real
+                        # cause (e.g. a mid-batch constraint violation,
+                        # where sqlite's statement-level ABORT already
+                        # backed the rows out but still counted them)
+                        raise UnrollbackableWrite(
+                            "SQL rows written inside a buffered savepoint-"
+                            "less transaction scope cannot be rolled back"
+                            " — route the write through the store buffer"
+                            " or materialize_savepoints first"
+                        ) from e
+                    raise
+                else:
+                    self._tx_depth -= 1
+                    buf.release_mark()
+                    sp, _ = self._lazy_sps.pop()
+                    if sp is not None:
+                        self._conn.execute(f"RELEASE SAVEPOINT {sp}")
+                return
+            self._sp_counter += 1
+            sp = f"sp_{self._sp_counter}"
+            self._conn.execute(f"SAVEPOINT {sp}")
             self._tx_depth += 1
             try:
                 yield self
             except BaseException:
                 self._tx_depth -= 1
-                if buf is not None:
-                    buf.rollback_mark()
                 self._conn.execute(f"ROLLBACK TO SAVEPOINT {sp}")
                 self._conn.execute(f"RELEASE SAVEPOINT {sp}")
                 raise
             else:
                 self._tx_depth -= 1
-                if buf is not None:
-                    buf.release_mark()
                 self._conn.execute(f"RELEASE SAVEPOINT {sp}")
+
+    def materialize_savepoints(self) -> None:
+        """Retro-open real SQL savepoints for every savepoint-less buffered
+        scope currently on the stack (outermost first, preserving nesting).
+        Called by anything about to write rows inside such a scope — the
+        store buffer's flush_through, the fee-history insert — so the
+        enclosing rollbacks regain their SQL undo.  A scope that already
+        saw row changes BEFORE materialization cannot be protected
+        retroactively (the retro savepoint would not cover them), so that
+        is refused loudly instead of silently half-protecting."""
+        for slot in self._lazy_sps:
+            if slot[0] is None:
+                if self._conn.total_changes != slot[1]:
+                    raise UnrollbackableWrite(
+                        "rows were already written inside this buffered"
+                        " scope before materialize_savepoints — a retro"
+                        " savepoint cannot cover them"
+                    )
+                self._sp_counter += 1
+                name = f"sp_{self._sp_counter}"
+                self._conn.execute(f"SAVEPOINT {name}")
+                slot[0] = name
 
     @property
     def in_transaction(self) -> bool:

@@ -22,6 +22,7 @@ from ..xdr.ledger import (
     UPGRADE_TYPE,
 )
 from ..xdr.ledger import TransactionMeta
+from ..database.database import UnrollbackableWrite
 from .accountframe import AccountFrame
 from .delta import LedgerDelta
 from .headerframe import LedgerHeaderFrame
@@ -397,6 +398,10 @@ class LedgerManager:
                     tx.fee_history_row(seq, index, this_tx_delta.get_changes())
                 )
                 this_tx_delta.commit()
+            # direct SQL write inside a (possibly savepoint-less) buffered
+            # scope: give the scope a real savepoint first so a failure
+            # after this point can still unwind the rows
+            self.database.materialize_savepoints()
             tx_history.insert_fee_rows(self.database, rows)
 
     def _apply_transactions(self, txs, ledger_delta, tx_result_set) -> None:
@@ -414,6 +419,12 @@ class LedgerManager:
                         delta.commit()
                     else:
                         assert not delta.get_changes()
+                except UnrollbackableWrite:
+                    # the SQL plane could not be unwound for this tx — DB
+                    # state is unknown; the close MUST abort (close_ledger
+                    # clears the entry cache and re-raises), a
+                    # txINTERNAL_ERROR continue would commit corrupt rows
+                    raise
                 except Exception as e:  # tx must never take down the close
                     log.error("exception during tx apply: %s", e)
                     tx.set_result_code(TransactionResultCode.txINTERNAL_ERROR)

@@ -143,6 +143,11 @@ class EntryStoreBuffer:
         restores the overlay, keeping both planes consistent."""
         if not self._overlay:
             return
+        # rows are about to land inside whatever scopes are open: give the
+        # lazy (savepoint-less) buffered scopes real SQL savepoints first,
+        # or an enclosing rollback could not undo these writes
+        # (database.py transaction(), buffered branch)
+        db.materialize_savepoints()
         if self._marks:
             for kb, slot in self._overlay.items():
                 self._undo.append((kb, slot))

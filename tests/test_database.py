@@ -107,3 +107,69 @@ class TestSchema:
     def test_connection_string_rejects_unknown_backend(self):
         with pytest.raises(ValueError):
             Database("postgresql://host/db")
+
+
+class TestLazyBufferedSavepoints:
+    """Buffered-mode transaction scopes skip the per-tx SQL SAVEPOINT
+    (2 statements/tx on the close path) and materialize real savepoints
+    only when something writes rows inside them (storebuffer
+    flush_through, the fee-history insert)."""
+
+    def _buffered_db(self):
+        from stellar_tpu.ledger.storebuffer import store_buffer_of
+
+        db = Database("sqlite3://:memory:")
+        db.execute("CREATE TABLE t (x INTEGER)")
+        buf = store_buffer_of(db)
+        with db.transaction():
+            buf.activate()
+            yield db, buf
+            buf.deactivate()
+
+    def test_no_savepoint_statements_in_buffered_scope(self):
+        gen = self._buffered_db()
+        db, buf = next(gen)
+        stmts = []
+        db._conn.set_trace_callback(stmts.append)
+        with db.transaction():
+            pass  # pure-buffered scope: no SQL at all
+        db._conn.set_trace_callback(None)
+        assert stmts == []
+        # ...while the same scope WITHOUT the buffer pays SAVEPOINT/RELEASE
+        buf.deactivate()
+        db._conn.set_trace_callback(stmts.append)
+        with db.transaction():
+            pass
+        db._conn.set_trace_callback(None)
+        buf.activate()
+        assert any("SAVEPOINT" in s for s in stmts)
+
+    def test_materialize_protects_in_scope_write(self):
+        gen = self._buffered_db()
+        db, buf = next(gen)
+        with pytest.raises(_Abort):
+            with db.transaction():
+                db.materialize_savepoints()
+                db.execute("INSERT INTO t (x) VALUES (1)")
+                raise _Abort()
+        assert db.query_one("SELECT COUNT(*) FROM t")[0] == 0  # rolled back
+
+    def test_unmaterialized_write_escalates_on_rollback(self):
+        from stellar_tpu.database.database import UnrollbackableWrite
+
+        gen = self._buffered_db()
+        db, buf = next(gen)
+        with pytest.raises(UnrollbackableWrite):
+            with db.transaction():
+                db.execute("INSERT INTO t (x) VALUES (1)")
+                raise _Abort()
+
+    def test_materialize_after_write_refused(self):
+        from stellar_tpu.database.database import UnrollbackableWrite
+
+        gen = self._buffered_db()
+        db, buf = next(gen)
+        with pytest.raises(UnrollbackableWrite):
+            with db.transaction():
+                db.execute("INSERT INTO t (x) VALUES (1)")
+                db.materialize_savepoints()
