@@ -517,3 +517,105 @@ def test_publish_catchup_via_s3_style_object_store(clock, tmp_path):
             app2.graceful_stop()
     finally:
         store.stop()
+
+
+# -- adversarial archives ---------------------------------------------------
+# CatchupStateMachine.cpp's acceptance machinery (bucket content hashes,
+# ledger-header hash chain, bucket-list hash vs the anchor) exists to keep
+# a tampered or bit-rotted archive from ever becoming local state.  These
+# tests corrupt a published archive in three distinct places and assert the
+# node REFUSES to sync rather than adopting bad state.
+
+
+def _publish_then_stop(clock, fresh_archive, instance):
+    app1 = make_app(clock, instance, fresh_archive, writable_archive=True)
+    try:
+        assert publish_checkpoint(app1, clock, accounts=True)
+    finally:
+        app1.graceful_stop()
+
+
+def _assert_rejected_not_synced(clock, fresh_archive, instance, complete):
+    """Crank until the catchup FSM positively REJECTS a round (retries
+    bumps) — not a fixed negative-wait, which would pass vacuously if a
+    healthy catchup were merely slow — then assert nothing was adopted."""
+    app2 = make_app(clock, instance, fresh_archive, writable_archive=False)
+    try:
+        app2.config.CATCHUP_COMPLETE = complete
+        lm2 = app2.ledger_manager
+        lm2.start_catchup()
+        sm = app2.history_manager.catchup
+        assert sm is not None
+        rejected = clock.crank_until(
+            lambda: sm.retries >= 1 or sm.state == "FAILED", 60
+        )
+        assert rejected, f"catchup never rejected (state {sm.state!r})"
+        assert lm2.state != LedgerState.LM_SYNCED_STATE
+        assert lm2.get_last_closed_ledger_num() == 1  # nothing adopted
+    finally:
+        app2.graceful_stop()
+
+
+def test_catchup_rejects_corrupt_bucket_payload(clock, fresh_archive):
+    """A flipped byte inside a bucket file (valid gzip, wrong content) must
+    fail the content-hash check (catchupsm '_apply_buckets' raise), not
+    get applied."""
+    import gzip
+
+    _publish_then_stop(clock, fresh_archive, 31)
+    bucket_files = glob.glob(
+        f"{fresh_archive}/bucket/**/bucket-*.xdr.gz", recursive=True
+    )
+    assert bucket_files
+    path = max(bucket_files, key=os.path.getsize)
+    data = bytearray(gzip.decompress(open(path, "rb").read()))
+    data[len(data) // 2] ^= 0xFF
+    with open(path, "wb") as f:
+        f.write(gzip.compress(bytes(data)))
+    _assert_rejected_not_synced(clock, fresh_archive, 32, complete=False)
+
+
+def test_catchup_rejects_tampered_header_chain(clock, fresh_archive):
+    """A flipped byte in a ledger-headers checkpoint file must fail the
+    header hash-chain verification (or XDR decode), never replay."""
+    import gzip
+
+    _publish_then_stop(clock, fresh_archive, 33)
+    ledger_files = glob.glob(
+        f"{fresh_archive}/ledger/**/ledger-*.xdr.gz", recursive=True
+    )
+    assert ledger_files
+    path = ledger_files[0]
+    data = bytearray(gzip.decompress(open(path, "rb").read()))
+    data[len(data) // 2] ^= 0xFF
+    with open(path, "wb") as f:
+        f.write(gzip.compress(bytes(data)))
+    _assert_rejected_not_synced(clock, fresh_archive, 34, complete=True)
+
+
+def test_catchup_rejects_has_bucket_swap(clock, fresh_archive):
+    """A HAS whose bucket list doesn't hash to the anchor header's
+    bucketListHash (here: two level hashes swapped — every individual
+    bucket file still verifies!) must be refused at assumeState."""
+    import json
+
+    _publish_then_stop(clock, fresh_archive, 35)
+    wk = os.path.join(fresh_archive, ".well-known/stellar-history.json")
+    has = json.loads(open(wk).read())
+    hashes = [
+        (i, lvl["curr"])
+        for i, lvl in enumerate(has["currentBuckets"])
+        if lvl["curr"] != "0" * 64
+    ]
+    assert len(hashes) >= 2, "need two non-empty levels to swap"
+    (i, a), (j, b) = hashes[0], hashes[1]
+    has["currentBuckets"][i]["curr"] = b
+    has["currentBuckets"][j]["curr"] = a
+    with open(wk, "w") as f:
+        f.write(json.dumps(has))
+    # the category dir copy of the HAS is what catchup fetches in some
+    # flows; tamper both if present
+    for p in glob.glob(f"{fresh_archive}/history/**/history-*.json", recursive=True):
+        with open(p, "w") as f:
+            f.write(json.dumps(has))
+    _assert_rejected_not_synced(clock, fresh_archive, 36, complete=False)
