@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""System-level throughput harnesses behind PROFILE.md's round-5 numbers
+(developer tools, CPU-runnable; not part of the test suite).
+
+    python profile_system.py bucket [n]            # [bucketbench] shape
+    python profile_system.py autoload [n_txs] [mix]  # [autoload] shape
+
+bucket: write two fresh n-entry buckets, then merge them through the
+native C engine (BucketTests.cpp:399 'file-backed buckets' flavor).
+autoload: auto-calibrated single-node load through FULL consensus
+(CoreTests.cpp:294; accelerated cadence, virtual clock), reporting real
+applied tx/s.  mix = payments | full (LoadGenerator.cpp:664-684 shapes).
+"""
+
+import random
+import sys
+import time
+
+
+def _cpu():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def bucket(n=100_000):
+    _cpu()
+    from stellar_tpu.bucket.bucket import Bucket
+    from stellar_tpu.ledger.entryframe import ledger_key_of
+    from stellar_tpu.main.application import Application
+    from stellar_tpu.tx import testutils as T
+    from stellar_tpu.util.clock import VirtualClock
+    from stellar_tpu.xdr.arbitrary import arbitrary_of
+    from stellar_tpu.xdr.ledger import LedgerEntry
+
+    clock = VirtualClock()
+    app = Application.create(clock, T.get_test_config(95), new_db=True)
+    bm = app.bucket_manager
+    rng = random.Random(7)
+    try:
+        live1 = [arbitrary_of(LedgerEntry, rng=rng) for _ in range(n)]
+        live2 = [arbitrary_of(LedgerEntry, rng=rng) for _ in range(n)]
+
+        t0 = time.perf_counter()
+        b1 = Bucket.fresh(bm, live1, [])
+        b2 = Bucket.fresh(bm, live2, [ledger_key_of(e) for e in live1[: n // 10]])
+        t_write = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        Bucket.merge(bm, b1, b2)
+        t_merge = time.perf_counter() - t0
+        total_in = 2 * n + n // 10
+        from stellar_tpu import native
+
+        engine = "C" if native.available() else "PYTHON-FALLBACK"
+        print(
+            f"n={n}/bucket: fresh-write {2 * n / t_write:,.0f} entries/s "
+            f"({t_write:.2f}s); {engine} merge {total_in / t_merge:,.0f} "
+            f"entries/s ({t_merge:.2f}s, {total_in} entries in)"
+        )
+    finally:
+        app.graceful_stop()
+        clock.shutdown()
+
+
+def autoload(n_txs=30_000, mix="payments"):
+    _cpu()
+    from stellar_tpu.main.application import Application
+    from stellar_tpu.simulation.loadgen import LoadGenerator
+    from stellar_tpu.tx import testutils as T
+    from stellar_tpu.util.clock import VIRTUAL_TIME, VirtualClock
+
+    n_accounts = max(100, n_txs // 60)
+    clock = VirtualClock(VIRTUAL_TIME)
+    cfg = T.get_test_config(96)
+    cfg.MANUAL_CLOSE = False
+    cfg.ARTIFICIALLY_ACCELERATE_TIME_FOR_TESTING = True
+    cfg.DESIRED_MAX_TX_PER_LEDGER = 10000
+    app = Application.create(clock, cfg, new_db=True)
+    try:
+        app.herder.bootstrap()
+        app.ledger_manager.current.header.maxTxSetSize = 10000
+        gen = LoadGenerator()
+        gen.generate_load(app, n_accounts, n_txs, 10, auto_rate=True, mix=mix)
+        total = n_accounts + n_txs
+        applied = app.metrics.new_meter(("ledger", "transaction", "count"), "tx")
+        t0 = time.perf_counter()
+        # time until the txs are IN CLOSED LEDGERS (the apply meter), not
+        # merely accepted by the herder — "applied tx/s" means applied
+        ok = clock.crank_until(
+            lambda: gen.is_done() and applied.count >= total, 1800
+        )
+        wall = time.perf_counter() - t0
+        done = min(total, applied.count)  # on timeout: only what landed
+        print(
+            f"mix={mix}: done={ok} {done}/{total} txs applied in "
+            f"{wall:.1f}s real = {done / wall:,.0f} tx/s end-to-end over "
+            f"{app.ledger_manager.get_last_closed_ledger_num()} ledgers "
+            f"(calibrated offered rate {gen.rate}/s)"
+        )
+    finally:
+        app.graceful_stop()
+        clock.shutdown()
+
+
+if __name__ == "__main__":
+    cmd = sys.argv[1] if len(sys.argv) > 1 else "bucket"
+    if cmd == "bucket":
+        bucket(int(sys.argv[2]) if len(sys.argv) > 2 else 100_000)
+    elif cmd == "autoload":
+        autoload(
+            int(sys.argv[2]) if len(sys.argv) > 2 else 30_000,
+            sys.argv[3] if len(sys.argv) > 3 else "payments",
+        )
+    else:
+        sys.exit(__doc__)
