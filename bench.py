@@ -619,7 +619,7 @@ def bench_ledger_close(n_txs=5000, n_ledgers=3):
     from stellar_tpu.herder.ledgerclose import LedgerCloseData
     from stellar_tpu.herder.txset import TxSetFrame
     from stellar_tpu.tx import testutils as T
-    from stellar_tpu.util.clock import VirtualClock
+    from stellar_tpu.util.clock import REAL_TIME, VirtualClock
     from stellar_tpu.main.application import Application
     from stellar_tpu.xdr import txs as X
     from stellar_tpu.xdr.ledger import StellarValue
@@ -627,7 +627,16 @@ def bench_ledger_close(n_txs=5000, n_ledgers=3):
     backend = "tpu" if jax.default_backend() == "tpu" else "cpu"
     cfg = T.get_test_config(97, backend=backend)
     cfg.DESIRED_MAX_TX_PER_LEDGER = n_txs * 2
-    clock = VirtualClock()
+    # phase attribution rides the span tracer (stellar_tpu/trace/): the
+    # timed closes below leave close.* spans whose p50s become the
+    # phase_breakdown_ms dict — the perf trajectory carries WHERE the
+    # close time goes, not just how much there is
+    cfg.TRACE_ENABLED = True
+    # REAL_TIME clock: closes here are driven synchronously (no cranking),
+    # and a VIRTUAL clock would stamp every span with an unmoving now() —
+    # zero durations.  Real mode routes the tracer onto time.monotonic, so
+    # the phase breakdown measures actual wall time.
+    clock = VirtualClock(REAL_TIME)
     app = Application.create(clock, cfg, new_db=True)
     try:
         from stellar_tpu.ledger.accountframe import AccountFrame
@@ -702,6 +711,10 @@ def bench_ledger_close(n_txs=5000, n_ledgers=3):
             warm.append((k.public_raw, m, k.sign(m)))
         app.sig_backend.verify_batch(warm)
 
+        # drop setup/warmup spans: the phase breakdown must describe ONLY
+        # the timed closes
+        app.tracer.clear()
+
         # timed ledgers: n_txs single-sig payments from distinct accounts
         times = []
         for j in range(n_ledgers):
@@ -728,6 +741,24 @@ def bench_ledger_close(n_txs=5000, n_ledgers=3):
             )
             times.append(time.perf_counter() - t0)
             assert ok, "payment txset must validate"
+        # per-phase p50s over the timed closes (trace/ aggregator): the
+        # close-phase spans plus the signature plane underneath them
+        agg = app.tracer.aggregates()
+        phase_names = (
+            "ledger.close",
+            "close.txset_validate",
+            "close.sig_flush",
+            "close.fees",
+            "close.apply",
+            "close.commit",
+            "txset.validate",
+            "sig.flush",
+        )
+        phase_breakdown = {
+            name: round(agg[name]["p50_ms"], 2)
+            for name in phase_names
+            if name in agg
+        }
         times.sort()
         p50 = statistics.median(times)
         p95 = times[min(len(times) - 1, int(0.95 * len(times)))]
@@ -737,6 +768,7 @@ def bench_ledger_close(n_txs=5000, n_ledgers=3):
             "ledger_close_txs": n_txs,
             "ledger_close_ledgers": n_ledgers,
             "ledger_close_sig_backend": backend,
+            "phase_breakdown_ms": phase_breakdown,
         }
     finally:
         app.graceful_stop()

@@ -20,6 +20,7 @@ import threading
 import time
 from typing import Any, Iterable, List, Optional, Sequence, Tuple
 
+from ..trace import NULL_TRACER
 from ..util import xlog
 from . import sodium
 from .sigcache import VerifySigCache
@@ -51,12 +52,16 @@ class CachingSigBackend(SigBackend):
     are served immediately, only misses reach the inner backend, and results
     scatter back into the cache."""
 
-    def __init__(self, inner: SigBackend, cache: VerifySigCache):
+    def __init__(self, inner: SigBackend, cache: VerifySigCache, tracer=None):
         self.inner = inner
         self.cache = cache
         self.name = inner.name
+        self._tracer = tracer if tracer is not None else NULL_TRACER
 
     def verify_batch(self, items: Sequence[VerifyTriple]) -> List[bool]:
+        # one sig-flush span per batch (never per item): batch size and the
+        # cache-hit/miss split are THE attribution the close trace needs
+        sp = self._tracer.begin("sig.flush")
         keys = [self.cache.key_for(pk, sig, msg) for pk, msg, sig in items]
         cached = self.cache.peek_many(keys)
         miss_idx = [i for i, c in enumerate(cached) if c is None]
@@ -67,6 +72,13 @@ class CachingSigBackend(SigBackend):
             )
             for i, ok in zip(miss_idx, fresh):
                 cached[i] = ok
+        self._tracer.end(
+            sp,
+            batch=len(items),
+            cache_hits=len(items) - len(miss_idx),
+            misses=len(miss_idx),
+            backend=self.name,
+        )
         return [bool(c) for c in cached]
 
     def stats(self) -> dict:
@@ -138,6 +150,9 @@ class TpuSigBackend(SigBackend):
     differential test suite (tests/test_ed25519_tpu.py)."""
 
     name = "tpu"
+    # class-level default: harness code (and tests) that build the backend
+    # via __new__ + hand-set attributes still get a working no-op tracer
+    _tracer = NULL_TRACER
 
     def __init__(
         self,
@@ -145,11 +160,13 @@ class TpuSigBackend(SigBackend):
         mesh=None,
         cpu_cutover: int = DEFAULT_TPU_CPU_CUTOVER,
         streams: Optional[int] = None,
+        tracer=None,
     ):
         from ..ops.ed25519 import BatchVerifier  # lazy: JAX import
 
+        self._tracer = tracer if tracer is not None else NULL_TRACER
         self._verifier = BatchVerifier(
-            max_batch=max_batch, mesh=mesh, streams=streams
+            max_batch=max_batch, mesh=mesh, streams=streams, tracer=tracer
         )
         # Below this many cache misses a device round-trip costs more than
         # looping libsodium on host — lone SCP envelopes and small tx sets
@@ -188,7 +205,10 @@ class TpuSigBackend(SigBackend):
     def verify_batch(self, items: Sequence[VerifyTriple]) -> List[bool]:
         if len(items) < self.cpu_cutover:
             self.n_cutover_items += len(items)
-            return _sodium_verify_loop(items)
+            with self._tracer.span(
+                "sig.host_verify", items=len(items), reason="cutover"
+            ):
+                return _sodium_verify_loop(items)
         # the lock covers only the latch read/write and the budget choice —
         # never the verify work itself, or every concurrent caller inherits
         # the slowest batch's host-verify latency
@@ -201,7 +221,10 @@ class TpuSigBackend(SigBackend):
             first = self._verifier.n_device_calls == 0
         if wedged:
             self.n_wedge_fallback_items += len(items)
-            return _sodium_verify_loop(items)
+            with self._tracer.span(
+                "sig.host_verify", items=len(items), reason="wedge-latch"
+            ):
+                return _sodium_verify_loop(items)
         result: List[Any] = [None]
         err: List[BaseException] = []
         done = threading.Event()
@@ -230,7 +253,10 @@ class TpuSigBackend(SigBackend):
             )
             # the orphaned worker's eventual completion is harmless: the
             # caller-side cache scatter-back writes identical values
-            return _sodium_verify_loop(items)
+            with self._tracer.span(
+                "sig.host_verify", items=len(items), reason="device-stall"
+            ):
+                return _sodium_verify_loop(items)
         if err:
             raise err[0]
         return result[0]
@@ -242,15 +268,20 @@ class TpuSigBackend(SigBackend):
         return s
 
 
-def make_backend(kind: str = "cpu", cache: VerifySigCache = None, **kw) -> SigBackend:
+def make_backend(
+    kind: str = "cpu",
+    cache: VerifySigCache = None,
+    tracer=None,
+    **kw,
+) -> SigBackend:
     if kind == "cpu":
         inner: SigBackend = CpuSigBackend()
     elif kind == "tpu":
-        inner = TpuSigBackend(**kw)
+        inner = TpuSigBackend(tracer=tracer, **kw)
     else:
         raise ValueError(f"unknown SIGNATURE_BACKEND {kind!r}")
     if cache is None:
         from .keys import verify_cache
 
         cache = verify_cache()
-    return CachingSigBackend(inner, cache)
+    return CachingSigBackend(inner, cache, tracer=tracer)

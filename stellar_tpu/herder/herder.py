@@ -125,6 +125,15 @@ class Herder(SCPDriver):
         # slot -> timer_id -> VirtualTimer (SCP nomination/ballot timers)
         self.scp_timers: Dict[int, Dict[int, VirtualTimer]] = {}
 
+        # trace/ spans keyed by slot index: whole-slot consensus
+        # (nominate → externalize), the currently-open nomination round,
+        # and the ballot phase.  Dangling spans for slots that never
+        # externalize are dropped (never ring-recorded) when a newer slot
+        # completes.
+        self._trace_slot_spans: Dict[int, object] = {}
+        self._trace_nom_spans: Dict[int, object] = {}
+        self._trace_ballot_spans: Dict[int, object] = {}
+
         m = app.metrics
         self.m_envelope_sign = m.new_meter(("scp", "envelope", "sign"), "envelope")
         self.m_envelope_validsig = m.new_meter(("scp", "envelope", "validsig"), "envelope")
@@ -438,11 +447,47 @@ class Herder(SCPDriver):
     def nominating_value(self, slot_index: int, value: bytes) -> None:
         log.debug("nominating value i=%d v=%s", slot_index, self.get_value_string(value))
 
+    def nomination_round_started(
+        self, slot_index: int, round_number: int, timed_out: bool
+    ) -> None:
+        """Per-round nomination latency: round N's span closes when round
+        N+1 starts (its timer fired), a ballot begins, or the slot
+        externalizes."""
+        tr = self.app.tracer
+        tr.end(self._trace_nom_spans.pop(slot_index, None))
+        self._trace_nom_spans[slot_index] = tr.begin(
+            "scp.nominate_round",
+            slot=slot_index,
+            round=round_number,
+            timed_out=timed_out,
+        )
+
+    def started_ballot_protocol(self, slot_index: int, ballot) -> None:
+        tr = self.app.tracer
+        tr.end(self._trace_nom_spans.pop(slot_index, None))
+        # only the FIRST ballot opens the span — later bump_state calls are
+        # counter bumps inside the same ballot phase
+        if slot_index not in self._trace_ballot_spans:
+            self._trace_ballot_spans[slot_index] = tr.begin(
+                "scp.ballot", slot=slot_index
+            )
+
     # ------------------------------------------------------------------
     # externalization
     # ------------------------------------------------------------------
     def value_externalized(self, slot_index: int, value: bytes) -> None:
         self.m_value_externalize.mark()
+        tr = self.app.tracer
+        tr.end(self._trace_nom_spans.pop(slot_index, None))
+        tr.end(self._trace_ballot_spans.pop(slot_index, None))
+        tr.end(self._trace_slot_spans.pop(slot_index, None))
+        for d in (
+            self._trace_nom_spans,
+            self._trace_ballot_spans,
+            self._trace_slot_spans,
+        ):
+            for stale in [s for s in d if s < slot_index]:
+                d.pop(stale)
         self.scp_timers.pop(slot_index, None)
         sv = StellarValue.from_xdr(value)  # validated upstream; crash if not
 
@@ -691,6 +736,12 @@ class Herder(SCPDriver):
 
         self.current_value = new_value.to_xdr()
         prev_value = lcl.header.scpValue.to_xdr()
+        # whole-slot consensus span: nominate → value_externalized (must be
+        # registered BEFORE nominate — a single-node network externalizes
+        # synchronously inside this call)
+        self._trace_slot_spans[slot_index] = self.app.tracer.begin(
+            "scp.consensus", slot=slot_index, txs=proposed.size()
+        )
         self.scp.nominate(slot_index, self.current_value, prev_value)
 
     # ------------------------------------------------------------------

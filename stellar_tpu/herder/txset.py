@@ -16,6 +16,7 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Tuple
 
 from ..crypto import SHA256
+from ..trace import tracer_of
 from ..tx.frame import TransactionFrame
 from ..xdr.ledger import TransactionSet
 from ..xdr.xtypes import PublicKey
@@ -223,25 +224,26 @@ class TxSetFrame:
 
     def check_valid(self, app) -> bool:
         """TxSetFrame.cpp:247-330."""
-        lcl = app.ledger_manager.get_last_closed_ledger_header()
-        if lcl.hash != self.previous_ledger_hash:
-            return False
-        if len(self.transactions) > lcl.header.maxTxSetSize:
-            return False
-
-        last_hash = b"\x00" * 32
-        for tx in self.transactions:
-            if tx.get_full_hash() < last_hash:
-                return False  # not in canonical order
-            last_hash = tx.get_full_hash()
-
-        self._prewarm_signature_cache(app)
-
-        for txs in self._account_tx_map().values():
-            ok, invalid = self._check_account_chain(app, list(txs))
-            if not ok or invalid:
+        with tracer_of(app).span("txset.validate", txs=len(self.transactions)):
+            lcl = app.ledger_manager.get_last_closed_ledger_header()
+            if lcl.hash != self.previous_ledger_hash:
                 return False
-        return True
+            if len(self.transactions) > lcl.header.maxTxSetSize:
+                return False
+
+            last_hash = b"\x00" * 32
+            for tx in self.transactions:
+                if tx.get_full_hash() < last_hash:
+                    return False  # not in canonical order
+                last_hash = tx.get_full_hash()
+
+            self._prewarm_signature_cache(app)
+
+            for txs in self._account_tx_map().values():
+                ok, invalid = self._check_account_chain(app, list(txs))
+                if not ok or invalid:
+                    return False
+            return True
 
     def trim_invalid(self, app) -> List[TransactionFrame]:
         """Remove invalid txs; returns the trimmed ones (TxSetFrame.cpp:190)."""

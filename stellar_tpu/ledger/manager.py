@@ -283,13 +283,27 @@ class LedgerManager:
 
     # -- THE close (LedgerManagerImpl.cpp:612-741) -------------------------
     def close_ledger(self, ledger_data) -> None:
-        if ledger_data.tx_set.previous_ledger_hash != self.last_closed.hash:
-            raise RuntimeError("txset mismatch: wrong previous ledger hash")
-        if ledger_data.tx_set.get_contents_hash() != ledger_data.value.txSetHash:
-            raise RuntimeError("corrupt transaction set")
+        tracer = self.app.tracer
+        close_sp = tracer.begin(
+            "ledger.close",
+            seq=ledger_data.ledger_seq,
+            txs=ledger_data.tx_set.size(),
+        )
+        # phase 1 of the close trace: the txset's linkage + contents-hash
+        # audit (the expensive signature validation traces separately as
+        # txset.validate / sig.flush wherever check_valid runs)
+        with tracer.span("close.txset_validate", txs=ledger_data.tx_set.size()):
+            if ledger_data.tx_set.previous_ledger_hash != self.last_closed.hash:
+                raise RuntimeError("txset mismatch: wrong previous ledger hash")
+            if (
+                ledger_data.tx_set.get_contents_hash()
+                != ledger_data.value.txSetHash
+            ):
+                raise RuntimeError("corrupt transaction set")
 
         try:
             self._close_ledger_txn(ledger_data)
+            tracer.end(close_sp)
         except BaseException:
             # the enclosing SQL transaction rolled back, but the decoded
             # -entry cache may hold post-apply values from the aborted
@@ -301,6 +315,8 @@ class LedgerManager:
             raise
 
     def _close_ledger_txn(self, ledger_data) -> None:
+        tracer = self.app.tracer
+        commit_sp = None
         with self._close_timer.time_scope(), self.database.transaction():
             sv = ledger_data.value
             self.current.header.scpValue = sv
@@ -332,18 +348,25 @@ class LedgerManager:
             try:
                 # pre-warm the verify cache for the whole set in one batch,
                 # overlapped with fee processing (signature checks only
-                # start at apply, after the join) — at apply every check hits
+                # start at apply, after the join) — at apply every check hits.
+                # The sig_flush span covers prewarm start → join, so the
+                # nested close.fees span shows how much of it the fee pass
+                # hid (the residual is the close's real sig-verify cost)
+                sig_sp = tracer.begin("close.sig_flush", txs=len(txs))
                 join_prewarm = ledger_data.tx_set.prewarm_signature_cache_async(
                     self.app
                 )
-                self._process_fees_seq_nums(txs, ledger_delta)
+                with tracer.span("close.fees", txs=len(txs)):
+                    self._process_fees_seq_nums(txs, ledger_delta)
                 join_prewarm()
+                tracer.end(sig_sp)
 
-                tx_result_set = TransactionResultSet([])
-                self._apply_transactions(txs, ledger_delta, tx_result_set)
-                ledger_delta.header.txSetResultHash = sha256(
-                    tx_result_set.to_xdr()
-                )
+                with tracer.span("close.apply", txs=len(txs)):
+                    tx_result_set = TransactionResultSet([])
+                    self._apply_transactions(txs, ledger_delta, tx_result_set)
+                    ledger_delta.header.txSetResultHash = sha256(
+                        tx_result_set.to_xdr()
+                    )
 
                 # consensus upgrades apply after the txset (validated before)
                 for raw in sv.upgrades:
@@ -358,6 +381,12 @@ class LedgerManager:
                     else:
                         raise RuntimeError(f"Unknown upgrade type {up.type}")
 
+                # phase 4: everything that makes the close durable — store
+                # -buffer flush, audit, delta commit, bucket add + header
+                # store + LCL pointers, and the enclosing SQL COMMIT (the
+                # span ends OUTSIDE the transaction block so fsync-dominated
+                # closes attribute that cost here, not to no phase)
+                commit_sp = tracer.begin("close.commit")
                 if buf is not None:
                     with self._flush_timer.time_scope():
                         buf.flush(self.database)
@@ -380,6 +409,11 @@ class LedgerManager:
 
             # queue any checkpoint inside this SQL transaction (crash-safe)
             self.app.history_manager.maybe_queue_history_checkpoint()
+        tracer.end(
+            commit_sp,
+            live=len(ledger_delta.get_live_entries()),
+            dead=len(ledger_delta.get_dead_entries()),
+        )
 
         # outside the transaction: kick publishing + bucket GC
         self.app.history_manager.publish_queued_history()

@@ -47,6 +47,17 @@ class Application:
             raise ValueError("NETWORK_PASSPHRASE not configured")
         self.network_id = sha256(config.NETWORK_PASSPHRASE.encode())
         self.metrics = MetricsRegistry(clock)
+        # span tracer (stellar_tpu/trace/): phase attribution for ledger
+        # close / sig flushes / SCP rounds / overlay fetches; aggregates
+        # fold into self.metrics as trace.<name> histograms
+        from ..trace import Tracer
+
+        self.tracer = Tracer(
+            enabled=config.TRACE_ENABLED,
+            ring_size=config.TRACE_RING_SIZE,
+            clock=clock,
+            metrics=self.metrics,
+        )
         self.database = Database(config.DATABASE, self.metrics)
         self.persistent_state = PersistentState(self.database)
         self.tmp_dirs = TmpDirManager(config.TMP_DIR_PATH)
@@ -57,6 +68,7 @@ class Application:
             max_batch=config.SIG_BATCH_MAX,
             cpu_cutover=config.TPU_CPU_CUTOVER,
             streams=config.SIG_VERIFY_STREAMS,
+            tracer=self.tracer,
         )
         self.bucket_manager = BucketManager(self)
         self.ledger_manager = LedgerManager(self)

@@ -52,6 +52,7 @@ class CommandHandler:
             "testtx": self.handle_testtx,
             "logrotate": self.handle_logrotate,
             "profiler": self.handle_profiler,
+            "trace": self.handle_trace,
         }
 
     # -- server plumbing ----------------------------------------------------
@@ -458,6 +459,23 @@ class CommandHandler:
             self._profiler_stop_failures = 0
             return {"status": "stopped", "dir": trace_dir}
         return {"error": "action must be start or stop"}
+
+    def handle_trace(self, q: dict) -> dict:
+        """Dump the span ring as Chrome trace_event JSON (stellar_tpu/trace/;
+        load in chrome://tracing or ui.perfetto.dev).  The per-name latency
+        aggregates ride along as top-level metadata both viewers ignore;
+        ``/trace?clear=1`` drops the ring after dumping (fresh window)."""
+        from ..trace import chrome_trace_json
+
+        tracer = self.app.tracer
+        spans, aggregates, dropped = tracer.snapshot(
+            clear=q.get("clear") == "1"
+        )
+        out = chrome_trace_json(spans)
+        out["aggregates"] = aggregates
+        out["enabled"] = tracer.enabled
+        out["dropped_spans"] = dropped
+        return out
 
     def handle_generateload(self, q: dict) -> dict:
         from ..simulation.loadgen import LoadGenerator

@@ -8,8 +8,96 @@ libsodium; we route every verify through the chosen SigBackend).
 from __future__ import annotations
 
 import os
-import tomllib
+
+try:
+    import tomllib
+except ModuleNotFoundError:  # Python < 3.11: stdlib tomllib missing
+    try:
+        import tomli as tomllib  # the identical pre-3.11 backport, if present
+    except ModuleNotFoundError:
+        tomllib = None  # Config.load falls back to _parse_minimal_toml
 from typing import Dict, List, Optional
+
+
+def _strip_toml_comment(line: str) -> str:
+    """Drop a trailing # comment, respecting quoted strings."""
+    in_str = False
+    for i, c in enumerate(line):
+        if c == '"' and (i == 0 or line[i - 1] != "\\"):
+            in_str = not in_str
+        elif c == "#" and not in_str:
+            return line[:i].strip()
+    return line.strip()
+
+
+def _split_toml_array(inner: str) -> List[str]:
+    """Split array elements on commas, respecting quoted strings."""
+    parts: List[str] = []
+    buf: List[str] = []
+    in_str = False
+    for i, c in enumerate(inner):
+        if c == '"' and (i == 0 or inner[i - 1] != "\\"):
+            in_str = not in_str
+            buf.append(c)
+        elif c == "," and not in_str:
+            parts.append("".join(buf).strip())
+            buf = []
+        else:
+            buf.append(c)
+    tail = "".join(buf).strip()
+    if tail:
+        parts.append(tail)
+    return [p for p in parts if p]
+
+
+def _toml_value(v: str, ln: int):
+    if v.startswith('"'):
+        end = v.find('"', 1)
+        while end > 0 and v[end - 1] == "\\":
+            end = v.find('"', end + 1)
+        if end < 1:
+            raise ValueError(f"unterminated string on config line {ln}")
+        return v[1:end].replace('\\"', '"')
+    if v.startswith("[") and v.endswith("]"):
+        return [_toml_value(p, ln) for p in _split_toml_array(v[1:-1])]
+    if v in ("true", "false"):
+        return v == "true"
+    try:
+        return int(v)
+    except ValueError:
+        pass
+    try:
+        return float(v)
+    except ValueError:
+        raise ValueError(f"unparseable config value on line {ln}: {v!r}")
+
+
+def _parse_minimal_toml(text: str) -> dict:
+    """Fallback parser for Python < 3.11 hosts: the flat subset our node
+    configs use — `KEY = value` lines, [SECTION] / [SECTION.SUB] tables,
+    quoted strings (incl. embedded # and ,), ints, floats, booleans, and
+    single-line arrays.  Not a general TOML implementation (no multiline
+    arrays/strings, no inline tables) — enough to boot a validator from
+    the documented config shape."""
+    root: dict = {}
+    cur = root
+    for ln, raw in enumerate(text.splitlines(), 1):
+        line = _strip_toml_comment(raw)
+        if not line:
+            continue
+        if line.startswith("[") and line.endswith("]"):
+            cur = root
+            for part in line[1:-1].strip().split("."):
+                nxt = cur.setdefault(part.strip(), {})
+                if not isinstance(nxt, dict):
+                    raise ValueError(f"table name collides with a key: {line}")
+                cur = nxt
+            continue
+        if "=" not in line:
+            raise ValueError(f"bad config line {ln}: {raw!r}")
+        key, _, val = line.partition("=")
+        cur[key.strip()] = _toml_value(val.strip(), ln)
+    return root
 
 from ..crypto.keys import PubKeyUtils, SecretKey
 from ..xdr.scp import SCPQuorumSet
@@ -88,6 +176,16 @@ class Config:
         from ..crypto.sigbackend import DEFAULT_TPU_CPU_CUTOVER
 
         self.TPU_CPU_CUTOVER = DEFAULT_TPU_CPU_CUTOVER
+        # TPU-native addition: structured span tracing (stellar_tpu/trace/).
+        # Enabled by default like the reference's always-on medida timers —
+        # spans are coarse (per close phase / per sig flush, never per tx),
+        # a few µs each.  False short-circuits every instrumented path to a
+        # shared no-op before touching the clock or ring (the overhead
+        # smoke test in tests/test_trace.py holds that contract).
+        self.TRACE_ENABLED = True
+        # completed spans kept for /trace; older spans are overwritten
+        # (ring wraparound), so memory is bounded regardless of uptime
+        self.TRACE_RING_SIZE = 8192
         # TPU-native addition: write-back entry store buffer during ledger
         # close — entry mutations accumulate in an overlay (reads see
         # through it) and flush as batched SQL once per close instead of
@@ -99,8 +197,12 @@ class Config:
     # -- loading -----------------------------------------------------------
     @classmethod
     def load(cls, path: str) -> "Config":
-        with open(path, "rb") as f:
-            data = tomllib.load(f)
+        if tomllib is None:
+            with open(path, "r", encoding="utf-8") as f:
+                data = _parse_minimal_toml(f.read())
+        else:
+            with open(path, "rb") as f:
+                data = tomllib.load(f)
         return cls.from_dict(data)
 
     @classmethod
@@ -152,6 +254,10 @@ class Config:
             raise ValueError(
                 f"SIG_VERIFY_STREAMS must be an int >= 1, "
                 f"got {self.SIG_VERIFY_STREAMS!r}"
+            )
+        if not (isinstance(self.TRACE_RING_SIZE, int) and self.TRACE_RING_SIZE >= 1):
+            raise ValueError(
+                f"TRACE_RING_SIZE must be an int >= 1, got {self.TRACE_RING_SIZE!r}"
             )
 
     def to_short_string(self, pk: PublicKey) -> str:

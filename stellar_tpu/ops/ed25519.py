@@ -306,7 +306,11 @@ class BatchVerifier:
         backend: str = "auto",
         streams: Optional[int] = None,
         host_assist: Optional[float] = None,
+        tracer=None,
     ):
+        from ..trace import NULL_TRACER
+
+        self._tracer = tracer if tracer is not None else NULL_TRACER
         self.max_batch = max_batch
         self.min_device_batch = min_device_batch
         self.mesh = mesh
@@ -372,7 +376,17 @@ class BatchVerifier:
             shard = NamedSharding(self.mesh, PSpec(None, batch_axis))
             vec = NamedSharding(self.mesh, PSpec(batch_axis))
             if self.backend == "pallas":
-                from jax import shard_map
+                # jax >= 0.6 exports shard_map at top level with a
+                # check_vma kwarg; 0.4/0.5 have the experimental module
+                # with the same check under its old name check_rep
+                try:
+                    from jax import shard_map
+
+                    check_kw = "check_vma"
+                except ImportError:
+                    from jax.experimental.shard_map import shard_map
+
+                    check_kw = "check_rep"
 
                 from .ed25519_pallas import verify_kernel_pallas
 
@@ -390,8 +404,8 @@ class BatchVerifier:
                     out_specs=PSpec(batch_axis),
                     # pallas_call's out_shape carries no varying-mesh-axes
                     # annotation; the per-shard kernel is trivially
-                    # batch-varying, so skip the VMA check
-                    check_vma=False,
+                    # batch-varying, so skip the VMA/replication check
+                    **{check_kw: False},
                 )
                 return jax.jit(
                     fn,
@@ -448,6 +462,7 @@ class BatchVerifier:
         # while device chunks upload/execute.  Peel only what exceeds a
         # whole device granule so small batches keep their single chunk.
         assist_join = None
+        assist_err: List[BaseException] = []
         if self.host_assist > 0.0 and len(todo) >= 4 * self._granule:
             host_n = int(len(todo) * self.host_assist)
             if host_n > 0:
@@ -460,11 +475,21 @@ class BatchVerifier:
                 import threading
 
                 def assist():
-                    oks = _sodium_verify_loop(
-                        [(pk, msg, sig) for _, pk, msg, sig in host_part]
-                    )
-                    for (i, *_), ok in zip(host_part, oks):
-                        out[i] = ok
+                    # a raise here must NOT die silently with the thread:
+                    # out[] rows would stay False and valid signatures
+                    # would be reported failed — capture and re-raise on
+                    # the caller after the join
+                    try:
+                        with self._tracer.span(
+                            "ed25519.host_assist", items=len(host_part)
+                        ):
+                            oks = _sodium_verify_loop(
+                                [(pk, msg, sig) for _, pk, msg, sig in host_part]
+                            )
+                            for (i, *_), ok in zip(host_part, oks):
+                                out[i] = ok
+                    except BaseException as e:
+                        assist_err.append(e)
 
                 _t = threading.Thread(
                     target=assist, name="verify-host-assist", daemon=True
@@ -481,7 +506,9 @@ class BatchVerifier:
 
         def drain_one():
             chunk, fut = pending.pop(0)
+            dsp = self._tracer.begin("ed25519.drain")
             results = np.asarray(fut)[: len(chunk)]
+            self._tracer.end(dsp, items=len(chunk))
             for (i, *_), ok in zip(chunk, results):
                 out[i] = bool(ok)
 
@@ -497,6 +524,11 @@ class BatchVerifier:
             # (r05 review)
             if assist_join is not None:
                 assist_join()
+        if assist_err:
+            # assist failure surfaces on the caller exactly like a device
+            # failure would — after the join, so no orphan thread races a
+            # retry for host cores
+            raise assist_err[0]
         # wall time of the whole batched call: staging + hashing + device
         # compute + sync (NOT device-only — see stats())
         self.verify_seconds += time.perf_counter() - t0
@@ -586,6 +618,7 @@ class BatchVerifier:
         if staged is None:
             return np.zeros(0, dtype=bool)
         a_bytes, r_bytes, s_bytes, h_bytes = staged
+        dsp = self._tracer.begin("ed25519.device_dispatch")
         if self.backend == "pallas":
             # raw uint8 byte columns; nibble split happens on device
             ok = self._kernel(
@@ -601,6 +634,7 @@ class BatchVerifier:
                 jnp.asarray(_nibbles_np(s_bytes)),
                 jnp.asarray(_nibbles_np(h_bytes)),
             )
+        self._tracer.end(dsp, bucket=a_bytes.shape[0], backend=self.backend)
         with self._calls_lock:
             self.n_device_calls += 1
         return ok

@@ -10,6 +10,7 @@ from __future__ import annotations
 from typing import Dict, Set
 
 from ..crypto import sha256
+from ..trace import tracer_of
 from ..util import xlog
 from ..xdr.base import xdr_to_opaque
 from ..xdr.overlay import StellarMessage
@@ -70,6 +71,8 @@ class Floodgate:
         re-flood each rebroadcast tick even to peers already told."""
         if self._shutting_down:
             return
+        tracer = tracer_of(self.app)
+        sp = tracer.begin("overlay.flood")
         key = self.message_key(msg)
         rec = self.flood_map.get(key)
         if rec is None or force:
@@ -79,10 +82,15 @@ class Floodgate:
             self.flood_map[key] = rec
             self.m_added.set_count(len(self.flood_map))
         om = self.app.overlay_manager
+        sent = 0
         for peer in list(om.authenticated_peers()):
             if peer not in rec.peers_told:
                 rec.peers_told.add(peer)
                 peer.send_message(msg)
+                sent += 1
+        tracer.end(
+            sp, msg_type=getattr(msg.type, "name", str(msg.type)), sent=sent
+        )
 
     def shutdown(self) -> None:
         self._shutting_down = True

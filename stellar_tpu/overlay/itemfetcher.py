@@ -11,6 +11,7 @@ from __future__ import annotations
 import random
 from typing import Callable, Dict, List, Optional
 
+from ..trace import tracer_of
 from ..util import VirtualTimer, xlog
 from ..xdr.overlay import MessageType, StellarMessage
 from ..xdr.scp import SCPEnvelope
@@ -30,6 +31,20 @@ class Tracker:
         self.timer = VirtualTimer(app.clock)
         self.envelopes: List[SCPEnvelope] = []
         self.num_list_rebuild = 0
+        # fetch latency span: opens with the tracker, ends at finish()
+        self._span = tracer_of(app).begin(
+            "overlay.fetch", item=item_hash.hex()[:8]
+        )
+
+    def finish(self, outcome: str) -> None:
+        """Close the fetch span (double-finish safe: end(None) is a no-op)."""
+        tracer_of(self.app).end(
+            self._span,
+            outcome=outcome,
+            asked=len(self.peers_asked),
+            rebuilds=self.num_list_rebuild,
+        )
+        self._span = None
 
     def listen(self, envelope: SCPEnvelope) -> None:
         self.envelopes.append(envelope)
@@ -105,6 +120,7 @@ class ItemFetcher:
         tr = self.trackers.pop(item_hash, None)
         if tr is not None:
             tr.cancel()
+            tr.finish("received")
 
     def stop_fetch(self, item_hash: bytes) -> None:
         self.recv(item_hash)
@@ -117,6 +133,7 @@ class ItemFetcher:
             ]
             if not tr.envelopes:
                 tr.cancel()
+                tr.finish("abandoned")
                 del self.trackers[h]
 
     def doesnt_have(self, item_hash: bytes, peer) -> None:

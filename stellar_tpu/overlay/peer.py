@@ -394,8 +394,11 @@ class Peer:
         self.send_peers()
 
     def recv_peers(self, msg: StellarMessage) -> None:
-        from .peerrecord import PeerRecord
+        import random
 
+        from .peerrecord import SECONDS_PER_BACKOFF, PeerRecord
+
+        cfg = self.app.config
         for addr in msg.value:
             if addr.ip.type != IPAddrType.IPv4:
                 continue
@@ -405,13 +408,27 @@ class Peer:
             try:
                 # numFailures deliberately NOT copied from the remote — we
                 # may have better luck, and remote data must not poison
-                # our backoff (Peer.cpp:1128-1141); private addresses are
-                # ignored outright
-                pr = PeerRecord(ip, addr.port, self.app.clock.now(), 0)
+                # our backoff (Peer.cpp:1128-1151); the first attempt is
+                # randomized over the new-peer window instead of now() so a
+                # PEERS burst doesn't stampede the next tick into dialing
+                # every learned address at once
+                pr = PeerRecord(
+                    ip,
+                    addr.port,
+                    self.app.clock.now()
+                    + random.uniform(0.0, SECONDS_PER_BACKOFF),
+                    0,
+                )
                 if pr.is_private_address():
                     log.warning("ignoring received private address %s", pr.to_string())
                     continue
-                pr.store(self.app.database)
+                if pr.is_self_address_and_port(self.ip(), cfg.PEER_PORT):
+                    log.debug("ignoring received self-address %s", pr.to_string())
+                    continue
+                if pr.is_localhost() and not cfg.ALLOW_LOCALHOST_FOR_TESTING:
+                    log.warning("ignoring received localhost %s", pr.to_string())
+                    continue
+                pr.insert_if_new(self.app.database)
             except Exception as e:
                 log.warning("could not store peer %s:%d: %s", ip, addr.port, e)
 

@@ -33,6 +33,18 @@ class PeerRecord:
     def to_string(self) -> str:
         return f"{self.ip}:{self.port}"
 
+    def is_localhost(self) -> bool:
+        """127/8 loopback (PeerRecord::isLocalhost)."""
+        try:
+            return ipaddress.ip_address(self.ip).is_loopback
+        except ValueError:
+            return False
+
+    def is_self_address_and_port(self, ip: str, port: int) -> bool:
+        """PeerRecord::isSelfAddressAndPort — remote-supplied lists can echo
+        an endpoint back at its owner."""
+        return self.ip == ip and self.port == port
+
     def is_private_address(self) -> bool:
         """RFC1918 check, exactly the reference's ranges
         (PeerRecord.cpp:213-229): 10/8, 172.16/12, 192.168/16.  NOT
@@ -78,6 +90,19 @@ class PeerRecord:
             (next_attempt_cutoff, max_num),
         )
         return [cls(*r) for r in rows]
+
+    def insert_if_new(self, db) -> bool:
+        """Store ONLY when the (ip, port) is unknown (PeerRecord::insertIfNew):
+        remote-supplied data must never clobber the backoff/next-attempt
+        state we already track for a known peer."""
+        if (
+            db.query_one(
+                "SELECT 1 FROM peers WHERE ip=? AND port=?", (self.ip, self.port)
+            )
+            is not None
+        ):
+            return False
+        return self.store(db)
 
     def store(self, db) -> bool:
         """Insert-or-update; returns True if newly inserted."""

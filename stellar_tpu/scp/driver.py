@@ -119,6 +119,13 @@ class SCPDriver(ABC):
 
     def nominating_value(self, slot_index: int, value: bytes) -> None: ...
 
+    def nomination_round_started(
+        self, slot_index: int, round_number: int, timed_out: bool
+    ) -> None:
+        """A nomination round began (round_number is 1-based; timed_out is
+        True when the previous round's timer re-entered nominate).  Hosts
+        use this for per-round latency spans (trace/)."""
+
     def updated_candidate_value(self, slot_index: int, value: bytes) -> None: ...
 
     def started_ballot_protocol(self, slot_index: int, ballot) -> None: ...

@@ -218,6 +218,12 @@ class NominationProtocol:
         self.started = True
         self.previous_value = previous_value
         self.round_number += 1
+        # monitoring hook: round boundaries drive the host's span tracing
+        # (round N's span closes when round N+1 starts, a ballot begins, or
+        # the slot externalizes — Herder.nomination_round_started)
+        self.slot.driver.nomination_round_started(
+            self.slot.index, self.round_number, timed_out
+        )
         self._update_round_leaders()
 
         updated = False

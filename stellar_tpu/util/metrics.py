@@ -153,6 +153,12 @@ class Histogram:
     def mean(self) -> float:
         return self._sum / self.count if self.count else 0.0
 
+    @property
+    def max_value(self) -> float:
+        """Largest recorded value (exact, not reservoir-sampled) — the trace
+        aggregator's max comes from here."""
+        return self._max if self._max is not None else 0.0
+
     def clear(self) -> None:
         """Reset the reservoir (medida Timer::Clear — the reference's
         auto-load calibration clears between adjustment periods)."""

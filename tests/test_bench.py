@@ -125,6 +125,12 @@ def test_bench_close_subprocess_success_path():
     assert out["ledger_close_txs"] == 50
     assert out["ledger_close_p50_ms"] > 0
     assert "ledger_close_error" not in out
+    # phase attribution (stellar_tpu/trace/) rides the BENCH json: the
+    # close phases must be present and account for real time
+    pb = out["phase_breakdown_ms"]
+    for phase in ("close.sig_flush", "close.apply", "close.commit"):
+        assert phase in pb, pb
+    assert pb["ledger.close"] > 0
 
 
 def test_probe_tpu_alive_success_path(monkeypatch):

@@ -3,7 +3,7 @@ sign/verify round trips, strkey round trips, HMAC/HKDF vectors, hex).
 """
 
 import pytest
-from hypothesis import given, strategies as st
+from _hypothesis_compat import given, st
 
 from stellar_tpu.crypto import (
     PubKeyUtils,
@@ -129,7 +129,10 @@ class TestKeys:
         assert PubKeyUtils.verify_sig(sk.get_public_key(), sig, b"")
 
     def test_cross_check_with_cryptography_lib(self):
-        """Independent implementation agreement (OpenSSL vs libsodium)."""
+        """Independent implementation agreement (OpenSSL vs libsodium).
+        Skips where pyca/cryptography isn't installed — the golden-vector
+        and libsodium differential tests still pin the implementation."""
+        pytest.importorskip("cryptography")
         from cryptography.hazmat.primitives.asymmetric.ed25519 import (
             Ed25519PrivateKey,
         )

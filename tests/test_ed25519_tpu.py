@@ -421,11 +421,16 @@ class TestShardedVerifier:
         assert bv.verify(items) == want
         assert bv.n_device_calls == 1  # one coalesced sharded dispatch
 
+    @pytest.mark.slow
     def test_sharded_pallas_verifier_on_mesh(self):
         """backend="pallas" with a mesh runs the Pallas kernel PER SHARD
         under shard_map (interpreter mode on the CPU mesh) — the multi-
         chip path that keeps the fast kernel on real TPU pods.  Two
-        devices bound the interpret cost (granule = 2*NT lanes)."""
+        devices bound the interpret cost (granule = 2*NT lanes).
+
+        slow: shard_map × pallas-interpret compiles for minutes on CPU
+        hosts — it would eat the tier-1 budget, so it runs only when slow
+        tests are selected (real-TPU runs compile it with Mosaic quickly)."""
         from stellar_tpu.ops.ed25519 import BatchVerifier
         from stellar_tpu.ops.ed25519_pallas import NT
         from stellar_tpu.parallel.mesh import make_mesh
