@@ -45,6 +45,8 @@ def _try_emit(extra: dict) -> bool:
     }
     if "libsodium" in _progress:
         out["libsodium_single_core_per_sec"] = _progress["libsodium"]
+    if "host_stage_us_per_item" in _progress:
+        out["host_stage_us_per_item"] = _progress["host_stage_us_per_item"]
     out.update(extra)
     _record_green(out)
     print(json.dumps(out), flush=True)
@@ -110,6 +112,22 @@ def _record_green(out: dict) -> None:
                 "(committed as BENCH_GREEN.json); this run hit a relay "
                 "outage window",
             }
+            try:
+                # the green run's age in hours: a driver-time outage line
+                # then self-documents how fresh the committed evidence is
+                # (VERDICT r05 next #2)
+                import calendar
+
+                t = calendar.timegm(
+                    time.strptime(
+                        g["measured_at_utc"], "%Y-%m-%dT%H:%M:%SZ"
+                    )
+                )
+                out["last_green_run"]["age_hours"] = round(
+                    max(0.0, (time.time() - t) / 3600.0), 1
+                )
+            except Exception:
+                pass  # malformed timestamp: keep the bare annotation
     except Exception:
         pass  # evidence plumbing must never break the one JSON line
 
@@ -274,6 +292,98 @@ def _wait_for_tpu(deadline: float, probe_timeout=90.0, pause=45.0) -> bool:
         time.sleep(pause)
 
 
+_ref_jaxfree = None
+
+
+def _ref25519_jaxfree():
+    """ops/ref25519 loaded by FILE PATH, bypassing stellar_tpu.ops's
+    __init__ (which imports jax).  The host-stage microbench runs BEFORE
+    the relay probe, and this file's standing invariant is that nothing
+    jax-shaped runs in-process until a killable child has proven the
+    backend alive — ref25519 itself is pure hashlib/numpy."""
+    global _ref_jaxfree
+    if _ref_jaxfree is None:
+        import importlib.util
+
+        path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "stellar_tpu", "ops", "ref25519.py",
+        )
+        spec = importlib.util.spec_from_file_location(
+            "_bench_ref25519", path
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _ref_jaxfree = mod
+    return _ref_jaxfree
+
+
+def bench_host_stage(items, reps=3):
+    """CPU-only microbench of the verify HOST stage (strict gate +
+    SHA-512(R‖A‖M) mod L + packed staging) in µs/item: the native C
+    stage (native/sighash.c) vs the displaced hashlib/numpy loop.
+
+    Touches no jax and no relay — it runs before the TPU probe, so even
+    a dead-window JSON line carries the host-stage evidence (the r06
+    acceptance table's fallback when no relay window opens)."""
+    import hashlib
+
+    import numpy as np
+
+    from stellar_tpu import native
+
+    ref = _ref25519_jaxfree()
+    n = len(items)
+    out = {}
+    blacklist = b"".join(ref.small_order_blacklist())
+    packed = np.empty((128, n), dtype=np.uint8)
+    okbuf = np.empty(n, dtype=np.uint8)
+
+    def best_of(fn, reps=reps):
+        b = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn()
+            b = min(b, time.perf_counter() - t0)
+        return b
+
+    mod = native.load_sighash()
+    if mod is not None:
+        t = best_of(lambda: mod.stage(items, 0, n, packed, okbuf, blacklist))
+        out["native_us_per_item"] = round(t * 1e6 / n, 3)
+        t = best_of(
+            lambda: mod.stage(items, 0, n, packed, okbuf, blacklist, 1)
+        )
+        out["native_1thread_us_per_item"] = round(t * 1e6 / n, 3)
+        assert okbuf.all(), "host-stage bench signatures must pass the gate"
+
+    def python_stage():
+        pk_arr = np.frombuffer(
+            b"".join(p for p, _, _ in items), np.uint8
+        ).reshape(-1, 32)
+        sig_arr = np.frombuffer(
+            b"".join(s for _, _, s in items), np.uint8
+        ).reshape(-1, 64)
+        gate = ref.strict_input_ok_batch(pk_arr, sig_arr)
+        assert gate.all()
+        sha = hashlib.sha512
+        packed[0:32] = pk_arr.T
+        packed[32:64] = sig_arr[:, :32].T
+        packed[64:96] = sig_arr[:, 32:].T
+        for j, (p, m, s) in enumerate(items):
+            h = (
+                int.from_bytes(sha(s[:32] + p + m).digest(), "little")
+                % ref.L
+            )
+            packed[96:128, j] = np.frombuffer(
+                h.to_bytes(32, "little"), np.uint8
+            )
+
+    t = best_of(python_stage)
+    out["python_us_per_item"] = round(t * 1e6 / n, 3)
+    return out
+
+
 def bench_libsodium_single_core(items, seconds=1.0):
     from stellar_tpu.crypto import sodium
 
@@ -352,6 +462,17 @@ def _main():
 
     cpu_rate = bench_libsodium_single_core(items, seconds=1.0)
     _progress.update(libsodium=round(cpu_rate, 1))
+    # host-stage A/B (native C vs hashlib/numpy), relay-independent: rides
+    # _progress so every exit path's JSON line carries it
+    if os.environ.get("BENCH_HOST_STAGE", "1") != "0":
+        _progress.update(stage="host-stage")
+        try:
+            _progress["host_stage_us_per_item"] = bench_host_stage(
+                items[: min(len(items), 16384)]
+            )
+        except Exception as e:
+            print(f"# bench: host-stage microbench failed: {e}",
+                  file=sys.stderr)
     # Probe the relay from killable children BEFORE any in-process jax
     # backend touch; keep probing (45s pauses) while the watchdog budget
     # lasts, so an outage ending mid-window still produces a real number.
@@ -493,13 +614,50 @@ def _main():
             file=sys.stderr,
         )
 
+    # Old-vs-new host-stage A/B: the same compiled kernel fed by the
+    # pre-r06 Python staging (per-item hashlib + numpy gate, GIL-bound)
+    # instead of the native C stage the headline ran on — the end-to-end
+    # worth of native/sighash.c in THIS window.  Never folded into the
+    # headline: the headline must describe the default configuration.
+    rate_pyhost = 0.0
+    want_py = (
+        not _platform_forced_cpu()
+        and os.environ.get("BENCH_HOSTSTAGE_AB", "1") != "0"
+        and bv._sighash is not None  # fallback build: legs identical
+    )
+    if want_py and rate > 0 and deadline - time.monotonic() > 120.0:
+        _progress.update(stage="verify-python-hoststage")
+        bv5 = BatchVerifier(max_batch=batch, streams=1, native_hash=False)
+        bv5._kernel = bv._kernel
+        try:
+            out = _retry(lambda: bv5.verify(items), tag="py-hoststage warmup")
+            assert all(out)
+            for _ in range(max(2, iters // 2)):
+                t0 = time.perf_counter()
+                out = _retry(lambda: bv5.verify(items), tag="py-hoststage pass")
+                dt = time.perf_counter() - t0
+                assert all(out)
+                rate_pyhost = max(rate_pyhost, len(items) / dt)
+        except Exception as e:  # the measured headline must survive
+            print(f"# bench: python host-stage A/B failed: {e}",
+                  file=sys.stderr)
+    elif want_py:
+        print(
+            "# bench: skipping python host-stage A/B "
+            "(<120s watchdog budget left)",
+            file=sys.stderr,
+        )
+
     result = {
         "batch": batch,
         "chunks": nchunks,
         "iters": iters,
         "speedup_vs_libsodium_core": round(rate / cpu_rate, 2),
         "device": _device_kind(),
+        "host_stage": "native" if bv._sighash is not None else "python",
     }
+    if rate_pyhost:
+        result["rate_python_hoststage"] = round(rate_pyhost, 1)
     if rate_2s:
         result["rate_1stream"] = round(best, 1)
         result["rate_2stream"] = round(rate_2s, 1)

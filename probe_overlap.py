@@ -36,7 +36,12 @@ def _staged_inputs(bv, batch, seed):
         sk = SecretKey.pseudo_random_for_testing(seed * 1_000_000 + i)
         msg = b"overlap probe %08d/%02d" % (i, seed)
         items.append((i, sk.public_raw, msg, sk.sign(msg)))
-    return tuple(np.ascontiguousarray(c.T) for c in bv._stage_chunk(items))
+    staged = bv._stage_chunk(items, 0, len(items))
+    # copy the four packed rows out: each probe round needs its own host
+    # buffers (the staging pool would otherwise reuse them)
+    return tuple(
+        staged.packed[32 * k : 32 * (k + 1)].copy() for k in range(4)
+    )
 
 
 def main(batch=32768, rounds=6):

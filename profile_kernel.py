@@ -34,9 +34,10 @@ def main(batch=32768, ab=False):
         sk = SecretKey.pseudo_random_for_testing(i)
         msg = b"kernel profile %08d" % i
         items.append((i, sk.public_raw, msg, sk.sign(msg)))
-    staged = bv._stage_chunk(items)
+    staged = bv._stage_chunk(items, 0, len(items))
+    # the packed (128, N) staging rows ARE the transposed byte columns
     a_b, r_b, s_b, h_b = (
-        jnp.asarray(np.ascontiguousarray(c.T)) for c in staged
+        jnp.asarray(staged.packed[32 * k : 32 * (k + 1)]) for k in range(4)
     )
 
     # fixed dispatch RTT: a trivial jitted op on the same arrays

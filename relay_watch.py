@@ -63,10 +63,14 @@ PAIR_GATE = {
     "close_cpu_5000": "close_tpu_5000",
 }
 # after the checklist: one full driver-shape bench re-run — BENCH_GREEN
-# evidence keeps the BEST complete run, so this can only improve it (the
-# first green was a mid-grade window without the 2-stream A/B)
+# evidence keeps the BEST complete run, so this can only improve it.
+# The step is named per-round (r06: native C host stage + the old-vs-new
+# host-stage A/B leg + the host-assist re-evaluation) so a state file
+# carried over from round 5 — where "bench_full" is already marked done —
+# still runs the round-6 bench in the first healthy window, while a
+# fresh state runs it exactly once.
 FINAL_STEPS = [
-    ("bench_full", [sys.executable, "-u", "bench.py"], 1600),
+    ("bench_hoststage_r06", [sys.executable, "-u", "bench.py"], 1600),
 ]
 ALL_NAMES = (
     [s[0] for s in SCRIPT_STEPS]

@@ -160,13 +160,21 @@ class TpuSigBackend(SigBackend):
         mesh=None,
         cpu_cutover: int = DEFAULT_TPU_CPU_CUTOVER,
         streams: Optional[int] = None,
+        native_hash: Optional[bool] = None,
         tracer=None,
     ):
         from ..ops.ed25519 import BatchVerifier  # lazy: JAX import
 
         self._tracer = tracer if tracer is not None else NULL_TRACER
+        # native_hash: the C host stage (gate + batch SHA-512 mod L,
+        # native/sighash.c) — default auto (on when it builds); stats()
+        # reports which stage is live as "native_host_stage"
         self._verifier = BatchVerifier(
-            max_batch=max_batch, mesh=mesh, streams=streams, tracer=tracer
+            max_batch=max_batch,
+            mesh=mesh,
+            streams=streams,
+            native_hash=native_hash,
+            tracer=tracer,
         )
         # Below this many cache misses a device round-trip costs more than
         # looping libsodium on host — lone SCP envelopes and small tx sets

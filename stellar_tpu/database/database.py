@@ -52,14 +52,43 @@ class Database:
             return cs[len("sqlite3://") :]
         raise ValueError(f"unsupported DATABASE connection string: {cs}")
 
+    def _unmaterialized_scopes(self) -> bool:
+        return any(slot[0] is None for slot in self._lazy_sps)
+
     # -- raw access --------------------------------------------------------
     # query_count feeds per-peer load attribution (overlay LoadManager)
     def execute(self, sql: str, params: Iterable = ()) -> sqlite3.Cursor:
         self.query_count += 1
-        return self._conn.execute(sql, tuple(params))
+        if not self._unmaterialized_scopes():
+            return self._conn.execute(sql, tuple(params))
+        # Inside a savepoint-less buffered scope, a FAILED statement's row
+        # changes were already backed out by sqlite's statement-level
+        # ABORT — but total_changes still counts them, which previously
+        # escalated a per-tx constraint violation into UnrollbackableWrite
+        # and aborted the whole ledger close (ADVICE r05).  Snapshot the
+        # counter per statement and credit the backed-out rows against
+        # every open lazy scope's baseline; a SUCCESSFUL direct write
+        # still trips the escalation exactly as before.
+        before = self._conn.total_changes
+        try:
+            return self._conn.execute(sql, tuple(params))
+        except sqlite3.Error:
+            backed_out = self._conn.total_changes - before
+            if backed_out:
+                for slot in self._lazy_sps:
+                    if slot[0] is None:
+                        slot[1] += backed_out
+            raise
 
     def executemany(self, sql: str, rows) -> sqlite3.Cursor:
         self.query_count += 1
+        # executemany is NOT statement-atomic: a constraint violation on
+        # row k backs out row k only — rows 0..k-1 persist, so the
+        # snapshot-credit trick above cannot apply.  Materialize real
+        # savepoints first; the enclosing rollbacks then regain SQL undo
+        # for whatever the batch wrote before failing.
+        if self._unmaterialized_scopes():
+            self.materialize_savepoints()
         return self._conn.executemany(sql, rows)
 
     def query_one(self, sql: str, params: Iterable = ()) -> Optional[Tuple]:
@@ -139,10 +168,11 @@ class Database:
                         self._conn.execute(f"ROLLBACK TO SAVEPOINT {sp}")
                         self._conn.execute(f"RELEASE SAVEPOINT {sp}")
                     elif self._conn.total_changes != changes0:
-                        # chain the original error — it may be the real
-                        # cause (e.g. a mid-batch constraint violation,
-                        # where sqlite's statement-level ABORT already
-                        # backed the rows out but still counted them)
+                        # a genuinely materialized direct write: execute()
+                        # credits statement-ABORT-backed-out rows against
+                        # changes0 and executemany() materializes first,
+                        # so reaching here means committed rows really
+                        # exist with no savepoint to unwind them
                         raise UnrollbackableWrite(
                             "SQL rows written inside a buffered savepoint-"
                             "less transaction scope cannot be rolled back"

@@ -158,39 +158,62 @@ _cxdr_mod = None
 _cxdr_tried = False
 
 
-def _build_cxdrpack() -> bool:
+def _load_extension(name: str, src: str, so: str, extra_flags=()):
+    """Build (if stale) and load a CPython extension .so by path.  The
+    unresolved CPython symbols bind into the running interpreter at
+    dlopen time, so no libpython link is needed."""
     import sysconfig
 
-    inc = sysconfig.get_paths()["include"]
-    return _compile_so(_CXDR_SRC, _CXDR_SO, (f"-I{inc}",))
+    if _needs_build(src, so):
+        inc = sysconfig.get_paths()["include"]
+        if not _compile_so(src, so, (f"-I{inc}", *extra_flags)):
+            return None
+    try:
+        import importlib.machinery
+        import importlib.util
+
+        loader = importlib.machinery.ExtensionFileLoader(name, so)
+        spec = importlib.util.spec_from_file_location(name, so, loader=loader)
+        mod = importlib.util.module_from_spec(spec)
+        loader.exec_module(mod)
+        return mod
+    except (ImportError, OSError):
+        return None
 
 
 def load_cxdrpack():
     """The compiled C pack interpreter module, or None (pure-Python
-    fallback).  Built on first use like the merge engine above; the
-    unresolved CPython symbols bind into the running interpreter at
-    dlopen time, so no libpython link is needed."""
+    fallback).  Built on first use like the merge engine above."""
     global _cxdr_mod, _cxdr_tried
     with _cxdr_lock:
         if _cxdr_mod is not None or _cxdr_tried:
             return _cxdr_mod
         _cxdr_tried = True
-        if _needs_build(_CXDR_SRC, _CXDR_SO):
-            if not _build_cxdrpack():
-                return None
-        try:
-            import importlib.machinery
-            import importlib.util
-
-            loader = importlib.machinery.ExtensionFileLoader(
-                "_cxdrpack", _CXDR_SO
-            )
-            spec = importlib.util.spec_from_file_location(
-                "_cxdrpack", _CXDR_SO, loader=loader
-            )
-            mod = importlib.util.module_from_spec(spec)
-            loader.exec_module(mod)
-            _cxdr_mod = mod
-        except (ImportError, OSError):
-            return None
+        _cxdr_mod = _load_extension("_cxdrpack", _CXDR_SRC, _CXDR_SO)
         return _cxdr_mod
+
+
+# -- sighash: the ed25519 batch host stage (CPython extension) ---------------
+
+_SIGHASH_SRC = os.path.join(_HERE, "sighash.c")
+_SIGHASH_SO = os.path.join(_HERE, "_sighash.so")
+
+_sighash_lock = threading.Lock()
+_sighash_mod = None
+_sighash_tried = False
+
+
+def load_sighash():
+    """The compiled batch gate+SHA-512-mod-L host stage
+    (``stage(items, start, count, out, ok, blacklist, threads)``), or
+    None (the verifier falls back to the hashlib/numpy staging loop).
+    Needs -pthread for the internal worker pool."""
+    global _sighash_mod, _sighash_tried
+    with _sighash_lock:
+        if _sighash_mod is not None or _sighash_tried:
+            return _sighash_mod
+        _sighash_tried = True
+        _sighash_mod = _load_extension(
+            "_sighash", _SIGHASH_SRC, _SIGHASH_SO, ("-pthread",)
+        )
+        return _sighash_mod

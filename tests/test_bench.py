@@ -46,6 +46,19 @@ def test_bench_emits_one_json_line():
     assert out["metric"] == "ed25519_verifies_per_sec"
     assert out["value"] > 0
     assert "watchdog" not in out
+    # the relay-independent host-stage A/B rides every completed line;
+    # the native keys (and the "native" stage label) appear only when a
+    # C toolchain built the extension — the hashlib fallback is a
+    # supported configuration, same contract as tests/test_sighash.py
+    from stellar_tpu import native
+
+    hs = out["host_stage_us_per_item"]
+    assert hs["python_us_per_item"] > 0
+    if native.load_sighash() is not None:
+        assert hs["native_us_per_item"] > 0
+        assert out["host_stage"] == "native"
+    else:
+        assert out["host_stage"] == "python"
 
 
 def test_bench_relay_down_reports_one_line_and_exits_2():
@@ -191,6 +204,28 @@ def test_record_green_evidence_paths(monkeypatch, tmp_path):
         out = {"value": 0.0, "relay_down": "probes failed"}
         bench._record_green(out)
         assert out["last_green_run"]["value"] == 100.0
+        # the annotation self-documents how stale the evidence is
+        # (VERDICT r05 next #2): just-written evidence reads ~0 hours
+        assert out["last_green_run"]["age_hours"] < 0.1
+
+        # a green file with an old timestamp reports its real age
+        rec = json.loads(green.read_text())
+        rec["measured_at_utc"] = "2026-01-01T00:00:00Z"
+        green.write_text(json.dumps(rec))
+        out_old = {"value": 0.0, "relay_down": "probes failed"}
+        bench._record_green(out_old)
+        assert out_old["last_green_run"]["age_hours"] > 24 * 30
+
+        # a malformed timestamp keeps the bare annotation (no age key)
+        rec["measured_at_utc"] = "not-a-time"
+        green.write_text(json.dumps(rec))
+        out_bad = {"value": 0.0, "relay_down": "probes failed"}
+        bench._record_green(out_bad)
+        assert "last_green_run" in out_bad
+        assert "age_hours" not in out_bad["last_green_run"]
+
+        # restore a healthy green file for the assertions below
+        bench._record_green({"value": 100.0, "device": "TPU v5 lite0"})
 
         # a full-run record (close metrics present) must not be replaced
         # by a later verify-only run

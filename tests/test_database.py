@@ -173,3 +173,62 @@ class TestLazyBufferedSavepoints:
             with db.transaction():
                 db.execute("INSERT INTO t (x) VALUES (1)")
                 db.materialize_savepoints()
+
+    def test_statement_abort_does_not_escalate(self):
+        """A constraint violation inside a buffered scope: sqlite's
+        statement-level ABORT already backed the rows out (total_changes
+        still counts them) — the scope rollback must surface the ORIGINAL
+        IntegrityError, not escalate to UnrollbackableWrite and abort the
+        whole ledger close (ADVICE r05, database.py:120)."""
+        import sqlite3
+
+        gen = self._buffered_db()
+        db, buf = next(gen)
+        db.execute("CREATE TABLE uniq (x INTEGER PRIMARY KEY)")
+        db.execute("INSERT INTO uniq (x) VALUES (1)")
+        baseline = db.query_one("SELECT COUNT(*) FROM uniq")[0]
+        with pytest.raises(sqlite3.IntegrityError):
+            with db.transaction():
+                # multi-row INSERT...SELECT: the second row collides, the
+                # whole statement is backed out, yet changes were counted
+                db.execute(
+                    "INSERT INTO uniq (x) SELECT 5 UNION ALL SELECT 1"
+                )
+        assert db.query_one("SELECT COUNT(*) FROM uniq")[0] == baseline
+
+    def test_statement_abort_then_real_write_still_escalates(self):
+        """The backed-out-rows credit must not mask a SUCCESSFUL
+        unmaterialized write that follows in the same scope."""
+        import sqlite3
+
+        from stellar_tpu.database.database import UnrollbackableWrite
+
+        gen = self._buffered_db()
+        db, buf = next(gen)
+        db.execute("CREATE TABLE uniq (x INTEGER PRIMARY KEY)")
+        db.execute("INSERT INTO uniq (x) VALUES (1)")
+        with pytest.raises(UnrollbackableWrite):
+            with db.transaction():
+                with pytest.raises(sqlite3.IntegrityError):
+                    db.execute("INSERT INTO uniq (x) VALUES (1)")
+                db.execute("INSERT INTO t (x) VALUES (7)")  # real write
+                raise _Abort()
+
+    def test_executemany_materializes_in_buffered_scope(self):
+        """executemany is not statement-atomic (rows before the failing
+        one persist), so buffered scopes materialize real savepoints
+        before it runs — a mid-batch violation then unwinds cleanly."""
+        import sqlite3
+
+        gen = self._buffered_db()
+        db, buf = next(gen)
+        db.execute("CREATE TABLE uniq (x INTEGER PRIMARY KEY)")
+        db.execute("INSERT INTO uniq (x) VALUES (3)")
+        with pytest.raises(sqlite3.IntegrityError):
+            with db.transaction():
+                db.executemany(
+                    "INSERT INTO uniq (x) VALUES (?)", [(10,), (11,), (3,)]
+                )
+        # rows 10/11 landed before the violation but the savepoint the
+        # buffered scope materialized rolled them back with the scope
+        assert db.query_one("SELECT COUNT(*) FROM uniq")[0] == 1

@@ -533,7 +533,7 @@ class TestMultiStream:
         idx_lock = threading.Lock()
         seen = []
 
-        def slow_even_stage(chunk):
+        def slow_even_stage(items, start, n):
             with idx_lock:
                 i = len(seen)
                 seen.append(i)
@@ -541,11 +541,11 @@ class TestMultiStream:
                 import time
 
                 time.sleep(0.05)  # even chunks stage slower than odd ones
-            return real_stage(chunk)
+            return real_stage(items, start, n)
 
         bv._stage_chunk = slow_even_stage
         bv._dispatch_staged = lambda staged: np.ones(
-            0 if staged is None else staged[0].shape[0], dtype=bool
+            0 if staged is None else staged.packed.shape[1], dtype=bool
         )
         items = []
         for i in range(16 * 8):  # 8 chunks through both streams
