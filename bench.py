@@ -47,6 +47,13 @@ def _try_emit(extra: dict) -> bool:
         out["libsodium_single_core_per_sec"] = _progress["libsodium"]
     if "host_stage_us_per_item" in _progress:
         out["host_stage_us_per_item"] = _progress["host_stage_us_per_item"]
+    if "scp_env" in _progress:
+        # ROADMAP #4: the SCP-envelope verify leg rides every line — the
+        # cpu-backed figure is relay-independent, so even a dead-window
+        # line carries it; a healthy window overwrites with the tpu leg
+        out["scp_envelope_verifies_per_sec"] = _progress["scp_env"]["rate"]
+        out["scp_envelope_backend"] = _progress["scp_env"]["backend"]
+        out["scp_envelope_n"] = _progress["scp_env"]["n"]
     out.update(extra)
     _record_green(out)
     print(json.dumps(out), flush=True)
@@ -384,6 +391,74 @@ def bench_host_stage(items, reps=3):
     return out
 
 
+def _scp_envelope_items(n):
+    """`n` ballot-protocol envelope verify triples from DISTINCT node keys
+    (worst case for the verify cache, which is bypassed) — built once per
+    run and shared by the cpu leg, the tpu warmup, and the tpu leg
+    (keygen + XDR pack + sign per item is several seconds of host work)."""
+    from stellar_tpu.crypto import SecretKey
+    from stellar_tpu.xdr.base import xdr_to_opaque
+    from stellar_tpu.xdr.entries import EnvelopeType
+    from stellar_tpu.xdr.scp import (
+        SCPBallot,
+        SCPStatement,
+        SCPStatementConfirm,
+        SCPStatementPledges,
+        SCPStatementType,
+    )
+
+    network_id = b"\x42" * 32
+    items = []
+    for i in range(n):
+        sk = SecretKey.pseudo_random_for_testing(20_000_000 + i)
+        st = SCPStatement(
+            nodeID=sk.get_public_key(),
+            slotIndex=1_000 + i,
+            pledges=SCPStatementPledges(
+                SCPStatementType.SCP_ST_CONFIRM,
+                SCPStatementConfirm(
+                    b"\x11" * 32, 1, SCPBallot(1, b"value %08d" % i), 1
+                ),
+            ),
+        )
+        payload = xdr_to_opaque(
+            network_id, EnvelopeType.ENVELOPE_TYPE_SCP, st
+        )
+        items.append((sk.public_raw, payload, sk.sign(payload)))
+    return items
+
+
+def bench_scp_envelopes(n=4096, backend=None, reps=3, items=None):
+    """SCP-envelope signature-verify throughput (ROADMAP #4; BASELINE.md's
+    fifth config; reference anchor HerderImpl.cpp:347-364 — verifyEnvelope
+    checks the node signature over xdr_to_opaque(networkID,
+    ENVELOPE_TYPE_SCP, statement)).
+
+    Flushes the envelope signature triples through `backend` in one
+    batch: exactly the shape Herder/overlay batch flushes take (raw
+    backend, no CachingSigBackend).  Default backend is a fresh
+    CpuSigBackend (relay-independent); the TPU leg passes a TpuSigBackend
+    after the relay probe."""
+    from stellar_tpu.crypto.sigbackend import CpuSigBackend
+
+    if items is None:
+        items = _scp_envelope_items(n)
+    n = len(items)
+    if backend is None:
+        backend = CpuSigBackend()
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = backend.verify_batch(items)
+        best = min(best, time.perf_counter() - t0)
+        assert all(out), "bench envelope signatures must all verify"
+    return {
+        "rate": round(n / best, 1),
+        "n": n,
+        "backend": backend.name,
+    }
+
+
 def bench_libsodium_single_core(items, seconds=1.0):
     from stellar_tpu.crypto import sodium
 
@@ -472,6 +547,20 @@ def _main():
             )
         except Exception as e:
             print(f"# bench: host-stage microbench failed: {e}",
+                  file=sys.stderr)
+    # SCP-envelope verify leg, cpu half: relay-independent, so EVERY JSON
+    # line (including dead-window ones) carries a measured number.  The
+    # envelope fixture is built ONCE and shared with the tpu leg below.
+    scp_items = None
+    if os.environ.get("BENCH_SCP_ENVS", "1") != "0":
+        _progress.update(stage="scp-envelopes-cpu")
+        try:
+            scp_items = _scp_envelope_items(
+                int(os.environ.get("BENCH_SCP_N", "4096"))
+            )
+            _progress["scp_env"] = bench_scp_envelopes(items=scp_items)
+        except Exception as e:
+            print(f"# bench: scp-envelope cpu leg failed: {e}",
                   file=sys.stderr)
     # Probe the relay from killable children BEFORE any in-process jax
     # backend touch; keep probing (45s pauses) while the watchdog budget
@@ -645,6 +734,40 @@ def _main():
         print(
             "# bench: skipping python host-stage A/B "
             "(<120s watchdog budget left)",
+            file=sys.stderr,
+        )
+
+    # SCP-envelope verify leg, tpu half: the same envelope batch through a
+    # TpuSigBackend (ROADMAP #4 asks the number through the SHIPPED
+    # backend, cutover + wedge machinery included, not the raw kernel).
+    # Shares nothing with the headline verifier, so it pays one untimed
+    # warmup batch for its bucket compile.
+    want_scp_tpu = (
+        not _platform_forced_cpu()
+        and scp_items is not None
+    )
+    if want_scp_tpu and deadline - time.monotonic() > 180.0:
+        _progress.update(stage="scp-envelopes-tpu")
+        try:
+            from stellar_tpu.crypto.sigbackend import TpuSigBackend
+
+            tb = TpuSigBackend(max_batch=len(scp_items))
+            _retry(
+                lambda: bench_scp_envelopes(
+                    backend=tb, reps=1, items=scp_items
+                ),
+                tag="scp-envelope warmup",
+            )
+            _progress["scp_env"] = bench_scp_envelopes(
+                backend=tb, items=scp_items
+            )
+        except Exception as e:  # the cpu leg's number survives
+            print(f"# bench: scp-envelope tpu leg failed: {e}",
+                  file=sys.stderr)
+    elif want_scp_tpu:
+        print(
+            "# bench: skipping tpu scp-envelope leg "
+            "(<180s watchdog budget left)",
             file=sys.stderr,
         )
 

@@ -7,6 +7,8 @@ ledger-close p50 (BASELINE.md second headline metric).  Usage:
     python profile_close.py [n_txs] [n_ledgers]          # cProfile a close
     python profile_close.py ladder [scale...] [--no-buffer]
     python profile_close.py ab [n_txs] [n_ledgers]       # buffer A/B
+    python profile_close.py fcab [n_txs] [n_ledgers]     # frame-context A/B
+    python profile_close.py --assert-budget [ms] [n_txs] # regression gate
 """
 
 import cProfile
@@ -20,7 +22,7 @@ import time
 # -- shared close-drive scaffold (used by main, ladder, and ab) -------------
 
 
-def _make_app(instance, n_txs, buffered=True):
+def _make_app(instance, n_txs, buffered=True, frame_context=True):
     from stellar_tpu.main.application import Application
     from stellar_tpu.tx import testutils as T
     from stellar_tpu.util.clock import VirtualClock
@@ -28,6 +30,7 @@ def _make_app(instance, n_txs, buffered=True):
     cfg = T.get_test_config(instance, backend="cpu")
     cfg.DESIRED_MAX_TX_PER_LEDGER = n_txs * 2
     cfg.ENTRY_WRITE_BUFFER = buffered
+    cfg.FRAME_CONTEXT = frame_context
     clock = VirtualClock()
     return Application.create(clock, cfg, new_db=True), clock
 
@@ -152,6 +155,18 @@ def main(n_txs=1000, n_ledgers=3):
             body = s.getvalue()
             # drop the boilerplate header lines
             print("\n".join(body.splitlines()[:40]))
+        # focused accounting for the round-7 acceptance levers — these
+        # functions fall out of the top-30 as they get cheap, so grep-able
+        # exact numbers beat eyeballing the tables
+        s = io.StringIO()
+        pstats.Stats(pr, stream=s).sort_stats("cumulative").print_stats(
+            r"load_account|metrics\.py|framecontext"
+        )
+        print("== focused (load_account / metrics / framecontext) ==")
+        print("\n".join(
+            l for l in s.getvalue().splitlines()
+            if "/" in l or "ncalls" in l
+        ))
     finally:
         app.graceful_stop()
         clock.shutdown()
@@ -236,38 +251,71 @@ def ladder(scales=(10**4, 10**5, 10**6), n_txs=5000, n_ledgers=3,
     return results
 
 
-def ab(n_txs=5000, n_ledgers=5):
-    """ENTRY_WRITE_BUFFER A/B: identical payment closes with the store
-    buffer on vs off; prints both close-only p50s and asserts the final
-    ledger hashes match (the PROFILE.md round-5 table's methodology).
-    Pair samples within one window — this host's speed drifts (see
-    PROFILE.md round-5 caveat)."""
+def _timed_close_run(instance, n_txs, n_ledgers, **make_app_kwargs):
+    """THE clean-close drive every measurement mode shares: populate,
+    close `n_ledgers` payment sets, return (close-only p50, final ledger
+    hash).  One copy so the A/B legs can never drift apart in workload."""
     from stellar_tpu.tx import testutils as T
 
-    def run(buffered):
-        app, clock = _make_app(97 if buffered else 98, n_txs,
-                               buffered=buffered)
-        try:
-            accounts = [T.get_account(i + 1) for i in range(n_txs + 1)]
-            created_at = _populate(app, accounts, n_txs)
-            times = []
-            for j in range(n_ledgers):
-                txs = _payment_txs(app, accounts, created_at, n_txs, j)
-                _total_s, close_s = _drive_close(app, txs)
-                times.append(close_s)
-            return statistics.median(times), app.ledger_manager.last_closed.hash
-        finally:
-            app.graceful_stop()
-            clock.shutdown()
+    app, clock = _make_app(instance, n_txs, **make_app_kwargs)
+    try:
+        accounts = [T.get_account(i + 1) for i in range(n_txs + 1)]
+        created_at = _populate(app, accounts, n_txs)
+        times = []
+        for j in range(n_ledgers):
+            txs = _payment_txs(app, accounts, created_at, n_txs, j)
+            _total_s, close_s = _drive_close(app, txs)
+            times.append(close_s)
+        return statistics.median(times), app.ledger_manager.last_closed.hash
+    finally:
+        app.graceful_stop()
+        clock.shutdown()
 
-    p50_on, h_on = run(True)
-    p50_off, h_off = run(False)
-    print(
-        f"ENTRY_WRITE_BUFFER on:  close p50 {p50_on * 1e3:.0f} ms\n"
-        f"ENTRY_WRITE_BUFFER off: close p50 {p50_off * 1e3:.0f} ms"
+
+def _knob_ab(knob, label, n_txs, n_ledgers, instances):
+    """On/off A/B over one _make_app kwarg: prints both close-only p50s
+    and asserts the final ledger hashes match.  Pair samples within one
+    window — this host's speed drifts (PROFILE.md round-5 caveat)."""
+    p50_on, h_on = _timed_close_run(
+        instances[0], n_txs, n_ledgers, **{knob: True}
     )
-    assert h_on == h_off, "ledger hash diverged between write modes!"
+    p50_off, h_off = _timed_close_run(
+        instances[1], n_txs, n_ledgers, **{knob: False}
+    )
+    print(
+        f"{label} on:  close p50 {p50_on * 1e3:.0f} ms\n"
+        f"{label} off: close p50 {p50_off * 1e3:.0f} ms"
+    )
+    assert h_on == h_off, f"ledger hash diverged between {label} modes!"
     print("final ledger hashes match")
+
+
+def ab(n_txs=5000, n_ledgers=5):
+    """ENTRY_WRITE_BUFFER A/B (the PROFILE.md round-5 table's
+    methodology)."""
+    _knob_ab("buffered", "ENTRY_WRITE_BUFFER", n_txs, n_ledgers, (97, 98))
+
+
+def fcab(n_txs=5000, n_ledgers=5):
+    """FRAME_CONTEXT A/B (the round-7 acceptance methodology)."""
+    _knob_ab("frame_context", "FRAME_CONTEXT", n_txs, n_ledgers, (93, 94))
+
+
+def assert_budget(budget_ms=2000.0, n_txs=5000, n_ledgers=3):
+    """Close-regression gate: clean (unprofiled) p50 of the standard
+    close drive, exit nonzero when it exceeds the budget.  relay_watch.py
+    queues this each green window so a regression shows up next to the
+    measurement that would otherwise mask it.  The default budget is the
+    quiet-window round-7 p50 plus this host's ±0.4 s window noise — a
+    REGRESSION gate, not the ≤1.0 s target itself."""
+    p50, _h = _timed_close_run(92, n_txs, n_ledgers)
+    ok = p50 * 1e3 <= budget_ms
+    print(
+        f"close p50 {p50 * 1e3:.0f} ms over {n_ledgers} closes of "
+        f"{n_txs} txs — budget {budget_ms:.0f} ms: "
+        f"{'OK' if ok else 'EXCEEDED'}"
+    )
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":
@@ -285,6 +333,18 @@ if __name__ == "__main__":
         ab(
             int(args[1]) if len(args) > 1 else 5000,
             int(args[2]) if len(args) > 2 else 5,
+        )
+    elif args and args[0] == "fcab":
+        fcab(
+            int(args[1]) if len(args) > 1 else 5000,
+            int(args[2]) if len(args) > 2 else 5,
+        )
+    elif args and args[0] == "--assert-budget":
+        sys.exit(
+            assert_budget(
+                float(args[1]) if len(args) > 1 else 2000.0,
+                int(args[2]) if len(args) > 2 else 5000,
+            )
         )
     else:
         main(

@@ -64,13 +64,20 @@ PAIR_GATE = {
 }
 # after the checklist: one full driver-shape bench re-run — BENCH_GREEN
 # evidence keeps the BEST complete run, so this can only improve it.
-# The step is named per-round (r06: native C host stage + the old-vs-new
-# host-stage A/B leg + the host-assist re-evaluation) so a state file
-# carried over from round 5 — where "bench_full" is already marked done —
-# still runs the round-6 bench in the first healthy window, while a
-# fresh state runs it exactly once.
+# The step is named per-round (r07: host-lean close + the SCP-envelope
+# verify leg on every line; r06's native-host-stage A/B legs still ride
+# along) so a state file carried over from an earlier round — where its
+# bench step is already marked done — still runs the round-7 bench in the
+# first healthy window, while a fresh state runs it exactly once.
 FINAL_STEPS = [
-    ("bench_hoststage_r06", [sys.executable, "-u", "bench.py"], 1600),
+    # r07 close-regression gate: clean cpu p50 vs budget, queued each green
+    # window so regressions land next to the measurement that would mask
+    # them (budget = r07 quiet-window p50 + this host's ±0.4s noise band;
+    # the step is cpu-only but green-window-paired for host-speed control)
+    ("close_budget_r07",
+     [sys.executable, "-u", "profile_close.py", "--assert-budget", "2000"],
+     1200),
+    ("bench_hoststage_r07", [sys.executable, "-u", "bench.py"], 1600),
 ]
 ALL_NAMES = (
     [s[0] for s in SCRIPT_STEPS]

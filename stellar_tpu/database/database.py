@@ -139,6 +139,13 @@ class Database:
             buf = getattr(self, "_store_buffer", None)
             if buf is not None and not buf.active:
                 buf = None
+            # the close-scoped frame identity map (ledger/framecontext.py)
+            # mirrors the same savepoint stack: a rolled-back scope evicts
+            # every frame it was lent, in lockstep with the buffer's
+            # overlay undo and the SQL savepoint
+            fctx = getattr(self, "_frame_context", None)
+            if fctx is not None and not fctx.active:
+                fctx = None
             if buf is not None:
                 # Buffered mode: entry stores accumulate in the overlay
                 # and history rows land at close end, so this scope wraps
@@ -156,6 +163,8 @@ class Database:
                 # rolled-back scope that wrote rows without a savepoint
                 # cannot be undone, so escalate instead of corrupting.
                 buf.push_mark()
+                if fctx is not None:
+                    fctx.push_mark()
                 self._lazy_sps.append([None, self._conn.total_changes])
                 self._tx_depth += 1
                 try:
@@ -163,6 +172,8 @@ class Database:
                 except BaseException as e:
                     self._tx_depth -= 1
                     buf.rollback_mark()
+                    if fctx is not None:
+                        fctx.rollback_mark()
                     sp, changes0 = self._lazy_sps.pop()
                     if sp is not None:
                         self._conn.execute(f"ROLLBACK TO SAVEPOINT {sp}")
@@ -183,6 +194,8 @@ class Database:
                 else:
                     self._tx_depth -= 1
                     buf.release_mark()
+                    if fctx is not None:
+                        fctx.release_mark()
                     sp, _ = self._lazy_sps.pop()
                     if sp is not None:
                         self._conn.execute(f"RELEASE SAVEPOINT {sp}")
@@ -190,6 +203,10 @@ class Database:
             self._sp_counter += 1
             sp = f"sp_{self._sp_counter}"
             self._conn.execute(f"SAVEPOINT {sp}")
+            if fctx is not None:
+                # write-through mode (buffer off, real savepoints) still
+                # needs the identity map unwound on rollback
+                fctx.push_mark()
             self._tx_depth += 1
             try:
                 yield self
@@ -197,10 +214,14 @@ class Database:
                 self._tx_depth -= 1
                 self._conn.execute(f"ROLLBACK TO SAVEPOINT {sp}")
                 self._conn.execute(f"RELEASE SAVEPOINT {sp}")
+                if fctx is not None:
+                    fctx.rollback_mark()
                 raise
             else:
                 self._tx_depth -= 1
                 self._conn.execute(f"RELEASE SAVEPOINT {sp}")
+                if fctx is not None:
+                    fctx.release_mark()
 
     def materialize_savepoints(self) -> None:
         """Retro-open real SQL savepoints for every savepoint-less buffered

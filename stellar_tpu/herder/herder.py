@@ -24,7 +24,7 @@ from ..scp import SCP, SCPDriver
 from ..scp.quorum import qset_hash as compute_qset_hash
 from ..scp.slot import Slot
 from ..util import VirtualTimer, xlog
-from ..xdr.base import xdr_to_opaque
+from ..xdr.base import xdr_getfield, xdr_to_opaque
 from ..xdr.entries import EnvelopeType
 from ..xdr.ledger import (
     LedgerUpgrade,
@@ -761,13 +761,15 @@ class Herder(SCPDriver):
         qsets: Dict[bytes, SCPQuorumSet] = {}
         for e in envs:
             for v in Slot.statement_values(e.statement):
+                # only the txSetHash is needed: C field accessor over the
+                # value bytes, no full StellarValue decode
                 try:
-                    sv = StellarValue.from_xdr(v)
+                    h = xdr_getfield(StellarValue, v, "txSetHash")
                 except Exception:
                     continue
-                ts = self.pending_envelopes.get_tx_set(sv.txSetHash)
+                ts = self.pending_envelopes.get_tx_set(h)
                 if ts is not None:
-                    txsets[sv.txSetHash] = ts
+                    txsets[h] = ts
             qh = Slot.companion_qset_hash(e.statement)
             if qh is not None:
                 qs = self.pending_envelopes.get_qset(qh)

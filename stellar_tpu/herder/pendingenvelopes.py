@@ -98,6 +98,13 @@ class PendingEnvelopes:
         qs = Slot.companion_qset_hash(st)  # None for EXTERNALIZE (self-quorum)
         txsets = []
         for v in Slot.statement_values(st):
+            # FULL decode, deliberately not the cheaper xdr_getfield
+            # (persist_scp_state uses it on our OWN statements): these
+            # values arrive from unverified peers, and a value malformed
+            # beyond a plausible-looking 32-byte prefix must be SKIPPED —
+            # treating its prefix as a txset dependency would wedge the
+            # envelope in `fetching` forever and spray item-fetch requests
+            # for a hash nobody has (code-review r7 finding)
             try:
                 sv = StellarValue.from_xdr(v)
             except Exception:

@@ -19,6 +19,7 @@ from ..xdr.entries import (
 from ..xdr.base import xdr_copy
 from ..xdr.ledger import LedgerKey, LedgerKeyAccount
 from .entryframe import EntryFrame, key_bytes
+from .framecontext import active_frame_context
 from .storebuffer import active_buffer
 
 
@@ -185,26 +186,64 @@ class AccountFrame(EntryFrame):
 
     @classmethod
     def load_account(
-        cls, account_id: PublicKey, db, readonly: bool = False
+        cls, account_id: PublicKey, db, readonly: bool = False,
+        signing: bool = False,
     ) -> Optional["AccountFrame"]:
         """readonly=True skips the defensive cache-hit copy: the returned
         frame SHARES the cached entry and must never be mutated or stored
         (EntryFrame._assert_mutable enforces the store half).  Validation
         paths load ~3x per tx and only read — the copy is ~40% of a warm
-        load (PROFILE.md round-5)."""
+        load (PROFILE.md round-5).
+
+        signing=True marks a tx-SOURCE load (TransactionFrame.load_account
+        — fee charging, validity at apply): inside an active close the
+        FrameContext identity map serves these with ONE frame per account
+        per close, so the per-load xdr_copy is paid once instead of per
+        touch.  ONLY signing loads take the map — the reference aliases
+        exactly one handle (mSigningAccount) per tx and snapshots
+        everything else, and destination/winner loads must keep that
+        fresh-snapshot semantics (a self path-payment's interleaved
+        credit/debit depends on it).  Readonly hits get a shell sharing
+        the context frame's live entry with the store guard set."""
         # account cache keys are prefix+pubkey on the wire; building the
         # bytes directly skips two XDR packs on the hottest load path
         kb = _ACCT_KEY_PREFIX + account_id.value
-        key = LedgerKey(LedgerEntryType.ACCOUNT, LedgerKeyAccount(account_id))
-        key._kb = kb
+        ctx = active_frame_context(db) if signing else None
+        if ctx is not None:
+            frame = ctx.lend(kb, not readonly)
+            if frame is not None:
+                if readonly:
+                    # live-state readonly shell, memoized per context
+                    # frame: readonly callers may only read, so sharing
+                    # one store-refusing wrapper is as safe as sharing
+                    # the entry itself
+                    shell = frame.__dict__.get("_ro_shell")
+                    if shell is None:
+                        shell = cls(frame.entry)
+                        shell._readonly = True
+                        frame._ro_shell = shell
+                    return shell
+                return frame
         cache = cls.cache_of(db)
         hit, cached = cache.peek(kb) if readonly else cache.get(kb)
         if hit:
             if cached is None:
                 return None
-            frame = cls(cached)
             if readonly:
-                frame._readonly = True
+                # the readonly FRAME is as shareable as the cached entry
+                # it wraps (both immutable to callers): memoize one shell
+                # per cache line, invalidated naturally when put_owned
+                # replaces the line with a new entry object.  Validation
+                # loads ~3x/tx; this drops their per-load frame ctor.
+                frame = cached.__dict__.get("_ro_frame")
+                if frame is None:
+                    frame = cls(cached)
+                    frame._readonly = True
+                    cached._ro_frame = frame
+                return frame
+            frame = cls(cached)
+            if ctx is not None:
+                ctx.adopt(kb, frame)
             return frame
         buf = active_buffer(db)
         if buf is not None:
@@ -220,7 +259,14 @@ class AccountFrame(EntryFrame):
                     frame = cls(pending)
                     frame._readonly = True
                     return frame
-                return cls(xdr_copy(pending))
+                frame = cls(xdr_copy(pending))
+                if ctx is not None:
+                    ctx.adopt(kb, frame)
+                return frame
+        # the LedgerKey object is only needed on the SQL-miss path
+        # (store_in_cache); hit paths key purely on the prefix+pubkey bytes
+        key = LedgerKey(LedgerEntryType.ACCOUNT, LedgerKeyAccount(account_id))
+        key._kb = kb
         aid = _aid(account_id)
         with db.timed("select", "account"):
             row = db.query_one(
@@ -264,6 +310,8 @@ class AccountFrame(EntryFrame):
             # but readonly must behave identically hit or miss — a caller
             # whose mutation "works" only on cold loads is a hidden bug
             frame._readonly = True
+        elif ctx is not None:
+            ctx.adopt(kb, frame)
         return frame
 
     @classmethod
@@ -433,6 +481,11 @@ class AccountFrame(EntryFrame):
             db.execute("DELETE FROM signers WHERE accountid=?", (aid,))
         delta.delete_entry_frame(self)
         self.store_in_cache(db, self.get_key(), None)
+        ctx = active_frame_context(db)
+        if ctx is not None:
+            # the close's identity map must not resurrect a deleted
+            # account; later loads consult the (deletion-carrying) planes
+            ctx.evict(key_bytes(self.get_key()))
 
     @classmethod
     def store_delete_by_key(cls, delta, db, key: LedgerKey) -> None:
@@ -442,6 +495,9 @@ class AccountFrame(EntryFrame):
             db.execute("DELETE FROM signers WHERE accountid=?", (aid,))
         delta.delete_entry(key)
         cls.store_in_cache(db, key, None)
+        ctx = active_frame_context(db)
+        if ctx is not None:
+            ctx.evict(key_bytes(key))
 
     # -- store-buffer flush (ledger/storebuffer.py) ------------------------
     _UPSERT_SQL = (

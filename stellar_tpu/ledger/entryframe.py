@@ -14,6 +14,7 @@ from typing import Optional
 from ..xdr.base import xdr_copy
 from ..xdr.entries import LedgerEntry, LedgerEntryType
 from ..xdr.ledger import LedgerKey
+from .framecontext import active_frame_context
 from .storebuffer import active_buffer
 
 
@@ -100,9 +101,18 @@ class EntryFrame:
     entry_type: LedgerEntryType = None
 
     # True on frames from a read-only load: the wrapped entry is SHARED
-    # with the entry cache (no defensive copy), so any store is a bug —
-    # guarded in store_add/store_change/store_delete
+    # with the entry cache (no defensive copy) or with a close-scoped
+    # context frame, so any store is a bug — guarded in
+    # store_add/store_change/store_delete
     _readonly = False
+
+    # set when a close-scoped FrameContext owns this frame (the identity
+    # map hands the same object to fee/validity/apply); a store after the
+    # context deactivates — or after a LATER close reactivated it — would
+    # write state from a finished close, so both are refused (the
+    # generation stamp catches the reactivation case)
+    _ctx = None
+    _ctx_gen = -1
 
     def __init__(self, entry: LedgerEntry):
         self.entry = entry
@@ -135,8 +145,17 @@ class EntryFrame:
         if self._readonly:
             raise RuntimeError(
                 f"store through a read-only {type(self).__name__} — its "
-                "entry is shared with the entry cache; load without "
-                "readonly=True to mutate"
+                "entry is shared with the entry cache or a close-scoped "
+                "frame; load without readonly=True to mutate"
+            )
+        ctx = self._ctx
+        if ctx is not None and (
+            not ctx.active or self._ctx_gen != ctx.generation
+        ):
+            raise RuntimeError(
+                f"store through a stale close-scoped {type(self).__name__}"
+                " — the FrameContext that lent it was deactivated (its"
+                " close is over); reload the entry to mutate"
             )
 
     def store_add(self, delta, db) -> None:
@@ -198,6 +217,14 @@ class EntryFrame:
         buf = active_buffer(db)
         if buf is not None:
             buf.record(kb, key, snap, type(self))
+        if self.entry_type == LedgerEntryType.ACCOUNT:
+            # the storing frame becomes the close's canonical working
+            # frame for this account (identity convergence: a frame built
+            # outside load_account — create_account, bucket apply — must
+            # not leave a stale mapped frame behind)
+            ctx = active_frame_context(db)
+            if ctx is not None:
+                ctx.record_store(kb, self)
 
     @staticmethod
     def cache_of(db) -> EntryCache:

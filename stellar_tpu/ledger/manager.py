@@ -328,6 +328,7 @@ class LedgerManager:
             # (chunked IN() selects) BEFORE the signature prewarm collects
             # its triples — both it and apply then run on a warm cache
             from .accountframe import AccountFrame
+            from .framecontext import frame_context_of
             from .storebuffer import store_buffer_of
 
             AccountFrame.bulk_warm_cache(
@@ -345,6 +346,17 @@ class LedgerManager:
             )
             if buf is not None:
                 buf.activate()
+            # close-scoped frame identity map: ONE AccountFrame per touched
+            # account across fee charging/validity/apply (framecontext.py).
+            # Activates at the same point as the buffer for the same
+            # reason: its savepoint marks pair with savepoints opened after
+            fctx = (
+                frame_context_of(self.database)
+                if getattr(self.app.config, "FRAME_CONTEXT", True)
+                else None
+            )
+            if fctx is not None:
+                fctx.activate()
             try:
                 # pre-warm the verify cache for the whole set in one batch,
                 # overlapped with fee processing (signature checks only
@@ -396,6 +408,11 @@ class LedgerManager:
                 # close and the pending writes are dropped with it
                 if buf is not None:
                     buf.deactivate()
+                # the identity map dies with the close — BEFORE the
+                # PARANOID audit below, whose fresh loads must hit the
+                # DB, never a mapped frame
+                if fctx is not None:
+                    fctx.deactivate()
 
             # the delta-vs-database audit runs against the flushed rows —
             # the same safety net that guarded write-through guards the

@@ -193,6 +193,14 @@ class Config:
         # reference-style write-through; the differential close tests run
         # both and compare ledger hashes.
         self.ENTRY_WRITE_BUFFER = True
+        # TPU-native addition: close-scoped frame identity map — ONE
+        # AccountFrame per touched account per close, shared by fee
+        # charging, validity checks, and apply instead of a defensive
+        # copy per load (ledger/framecontext.py).  Off = reference-style
+        # fresh load per touch; the differential suite
+        # (tests/test_framecontext.py) runs both and compares ledger
+        # hashes + SQL dumps + history metas.
+        self.FRAME_CONTEXT = True
 
     # -- loading -----------------------------------------------------------
     @classmethod

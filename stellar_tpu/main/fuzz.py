@@ -31,6 +31,48 @@ def msg_summary(m: StellarMessage) -> str:
     return f"{m.type.name}:{sha256(m.to_xdr()).hex()[:8]}"
 
 
+def _mutate_one_field(m: StellarMessage, rng: random.Random):
+    """A structurally-valid single-field mutant of `m`, via the C setfield
+    accessor over the packed bytes (native/cxdrpack.c) — structured
+    mutation that survives XDR decode, so it exercises the SEMANTIC
+    validation planes the byte-flip fuzz path bounces off.  Only
+    fixed-width scalar paths are mutable in place (setfield's contract)."""
+    from ..xdr import base as B
+    from ..xdr.base import iter_scalar_field_paths, xdr_setfield
+
+    data = m.to_xdr()
+    codec = B.codec_of(m)
+    paths = [
+        (p, leaf)
+        for p, leaf, _v in iter_scalar_field_paths(codec, m)
+        if isinstance(
+            leaf,
+            (B._UInt32, B._Int32, B._UInt64, B._Int64, B._Bool, B._Enum,
+             B._Opaque),
+        )
+    ]
+    if not paths:
+        return None
+    path, leaf = paths[rng.randrange(len(paths))]
+    if isinstance(leaf, B._Enum):
+        val = rng.choice(list(leaf.enum_cls))
+    elif isinstance(leaf, B._Bool):
+        val = rng.random() < 0.5
+    elif isinstance(leaf, B._Opaque):
+        val = rng.randbytes(leaf.n)
+    elif isinstance(leaf, (B._UInt32, B._UInt64)):
+        bits = 32 if isinstance(leaf, B._UInt32) else 64
+        val = rng.getrandbits(rng.choice((1, 8, bits)))
+        val &= (1 << bits) - 1
+    else:
+        bits = 32 if isinstance(leaf, B._Int32) else 64
+        val = rng.getrandbits(bits - 1) - rng.getrandbits(bits - 1)
+    try:
+        return StellarMessage.from_xdr(xdr_setfield(codec, data, path, val))
+    except XdrError:
+        return None  # e.g. bad-union mutant: structurally undecodable
+
+
 def gen_fuzz(filename: str, n: int = 3, seed: int = None) -> None:
     rng = random.Random(seed)
     log.info("writing %d-message random fuzz file %s", n, filename)
@@ -45,6 +87,16 @@ def gen_fuzz(filename: str, n: int = 3, seed: int = None) -> None:
             out.write_one(m)
             log.info("message %d: %s", written, msg_summary(m))
             written += 1
+            # every other seed also gets a single-field setfield mutant:
+            # same structure, one scalar off — the shape byte-flips rarely
+            # reach (they usually break decode before semantics)
+            if written < n and rng.random() < 0.5:
+                mut = _mutate_one_field(m, rng)
+                if mut is not None:
+                    out.write_one(mut)
+                    log.info("message %d: %s (field mutant)",
+                             written, msg_summary(mut))
+                    written += 1
 
 
 def _try_read(stream: XDRInputFileStream):

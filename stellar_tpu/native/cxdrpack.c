@@ -821,6 +821,458 @@ copy_node(Walk *w, int idx, PyObject *val)
     return NULL;
 }
 
+/* -- hot-field accessors (getfield / setfield) ----------------------- */
+/* Walk the compiled spec over RAW XDR BYTES, skipping everything that is
+ * not on the requested field path, and read (or patch, for fixed-width
+ * scalars) the terminal value without a full unpack.  Path steps are
+ * ints interpreted per node kind: struct = field index, union = EXPECTED
+ * discriminant (mismatch raises XdrError), array = element index.
+ * Option and depth nodes are transparent (consume no step); an absent
+ * option on the path yields None from getfield and XdrError from
+ * setfield.  Skipping bounds-checks lengths/counts exactly like the
+ * unpacker (incl. the hostile-count guard) but does NOT validate padding
+ * content or UTF-8 — getfield is an accessor, not a validator; full
+ * validation stays with unpack. */
+
+#define MAX_FIELD_PATH 16
+
+static int
+skip_node(Walk *w, int idx, Rd *rd)
+{
+    Node *nd = &w->prog->nodes[idx];
+    switch (nd->kind) {
+    case K_U32: case K_I32: case K_BOOL: case K_ENUM:
+        if (rd_need(w, rd, 4, "scalar") < 0)
+            return -1;
+        rd->off += 4;
+        return 0;
+    case K_U64: case K_I64:
+        if (rd_need(w, rd, 8, "scalar") < 0)
+            return -1;
+        rd->off += 8;
+        return 0;
+    case K_OPAQUE: {
+        Py_ssize_t n = nd->a + (4 - (nd->a % 4)) % 4;
+        if (rd_need(w, rd, n, "opaque") < 0)
+            return -1;
+        rd->off += n;
+        return 0;
+    }
+    case K_VAROPAQUE:
+    case K_STRING: {
+        if (rd_need(w, rd, 4, "length") < 0)
+            return -1;
+        unsigned int n = rd_be32(rd);
+        if (n > nd->a)
+            return xdr_err(w, "opaque<%lld> length %u", nd->a, n);
+        Py_ssize_t body = (Py_ssize_t)n + (4 - (n % 4)) % 4;
+        if (rd_need(w, rd, body, "var opaque") < 0)
+            return -1;
+        rd->off += body;
+        return 0;
+    }
+    case K_ARRAY: {
+        for (long long i = 0; i < nd->a; i++) {
+            if (skip_node(w, nd->child[0], rd) < 0)
+                return -1;
+        }
+        return 0;
+    }
+    case K_VARARRAY: {
+        if (rd_need(w, rd, 4, "array length") < 0)
+            return -1;
+        unsigned int n = rd_be32(rd);
+        if (n > nd->a)
+            return xdr_err(w, "array<%lld> length %u", nd->a, n);
+        if ((Py_ssize_t)n > (rd->len - rd->off) / 4)
+            return xdr_err(w, "short buffer for array of %u elements", n);
+        for (unsigned int i = 0; i < n; i++) {
+            if (skip_node(w, nd->child[0], rd) < 0)
+                return -1;
+        }
+        return 0;
+    }
+    case K_OPTION: {
+        if (rd_need(w, rd, 4, "option flag") < 0)
+            return -1;
+        unsigned int v = rd_be32(rd);
+        if (v > 1)
+            return xdr_err(w, "bad bool discriminant %u", v);
+        return v ? skip_node(w, nd->child[0], rd) : 0;
+    }
+    case K_STRUCT: {
+        for (int i = 0; i < nd->nchild; i++) {
+            if (skip_node(w, nd->child[i], rd) < 0)
+                return -1;
+        }
+        return 0;
+    }
+    case K_UNION: {
+        if (rd_need(w, rd, 4, "discriminant") < 0)
+            return -1;
+        long dv = (long)(int)rd_be32(rd);
+        PyObject *key;
+        if (nd->sw_kind == 2)
+            key = PyLong_FromUnsignedLong((unsigned long)(unsigned int)dv);
+        else
+            key = PyLong_FromLong(dv);
+        if (!key)
+            return -1;
+        if (nd->sw_kind == 0) {
+            int has = PyDict_Contains(nd->members, key);
+            if (has <= 0) {
+                Py_DECREF(key);
+                return has < 0 ? -1
+                               : xdr_err(w, "bad enum value %ld", dv);
+            }
+        }
+        PyObject *slot = PyDict_GetItemWithError(nd->arms, key);
+        Py_DECREF(key);
+        int child = -2;
+        if (slot) {
+            child = (int)PyLong_AsLong(slot);
+        } else if (PyErr_Occurred()) {
+            return -1;
+        } else if (!nd->a) {
+            return xdr_err(w, "bad union discriminant %ld", dv);
+        }
+        return child >= 0 ? skip_node(w, child, rd) : 0;
+    }
+    case K_DEPTH: {
+        int *d = &w->depths[nd->depth_slot];
+        if (++*d > nd->a) {
+            --*d;
+            return xdr_err(w, "recursion deeper than %lld", nd->a);
+        }
+        int rc = skip_node(w, nd->child[0], rd);
+        --*d;
+        return rc;
+    }
+    }
+    return xdr_err(w, "corrupt program: unknown node kind");
+}
+
+/* Walk to the terminal node of `path`.  Returns the terminal node index
+ * with rd->off at its first byte, -1 on error, or -2 when an ABSENT
+ * option was hit on/at the end of the path (getfield returns None). */
+static int
+walk_path(Walk *w, Rd *rd, const long long *path, int n_path)
+{
+    int idx = w->prog->root;
+    int step = 0;
+    for (;;) {
+        Node *nd = &w->prog->nodes[idx];
+        switch (nd->kind) {
+        case K_DEPTH:
+            idx = nd->child[0];
+            continue;
+        case K_OPTION: {
+            if (rd_need(w, rd, 4, "option flag") < 0)
+                return -1;
+            unsigned int v = rd_be32(rd);
+            if (v > 1) {
+                xdr_err(w, "bad bool discriminant %u", v);
+                return -1;
+            }
+            if (!v)
+                return -2; /* absent on path */
+            idx = nd->child[0];
+            continue;
+        }
+        case K_STRUCT: {
+            if (step >= n_path)
+                return idx;
+            long long k = path[step++];
+            if (k < 0 || k >= nd->nchild) {
+                xdr_err(w, "field index %lld out of range", k);
+                return -1;
+            }
+            for (long long i = 0; i < k; i++) {
+                if (skip_node(w, nd->child[i], rd) < 0)
+                    return -1;
+            }
+            idx = nd->child[(int)k];
+            continue;
+        }
+        case K_UNION: {
+            if (step >= n_path)
+                return idx;
+            long long want = path[step++];
+            if (rd_need(w, rd, 4, "discriminant") < 0)
+                return -1;
+            long dv = (long)(int)rd_be32(rd);
+            long long got =
+                nd->sw_kind == 2
+                    ? (long long)(unsigned long)(unsigned int)dv
+                    : (long long)dv;
+            if (got != want) {
+                xdr_err(w, "union arm mismatch: value carries %lld,"
+                           " path expects %lld", got, want);
+                return -1;
+            }
+            PyObject *key = PyLong_FromLongLong(got);
+            if (!key)
+                return -1;
+            PyObject *slot = PyDict_GetItemWithError(nd->arms, key);
+            Py_DECREF(key);
+            if (!slot) {
+                if (PyErr_Occurred())
+                    return -1;
+                xdr_err(w, "bad union discriminant %lld", got);
+                return -1;
+            }
+            int child = (int)PyLong_AsLong(slot);
+            if (child < 0) {
+                xdr_err(w, "void union arm %lld on field path", got);
+                return -1;
+            }
+            idx = child;
+            continue;
+        }
+        case K_ARRAY:
+        case K_VARARRAY: {
+            if (step >= n_path)
+                return idx;
+            long long k = path[step++];
+            Py_ssize_t n;
+            if (nd->kind == K_ARRAY) {
+                n = nd->a;
+            } else {
+                if (rd_need(w, rd, 4, "array length") < 0)
+                    return -1;
+                unsigned int ln = rd_be32(rd);
+                if (ln > nd->a) {
+                    xdr_err(w, "array<%lld> length %u", nd->a, ln);
+                    return -1;
+                }
+                if ((Py_ssize_t)ln > (rd->len - rd->off) / 4) {
+                    xdr_err(w, "short buffer for array of %u elements", ln);
+                    return -1;
+                }
+                n = (Py_ssize_t)ln;
+            }
+            if (k < 0 || k >= n) {
+                xdr_err(w, "array index %lld out of range (%zd)", k, n);
+                return -1;
+            }
+            for (long long i = 0; i < k; i++) {
+                if (skip_node(w, nd->child[0], rd) < 0)
+                    return -1;
+            }
+            idx = nd->child[0];
+            continue;
+        }
+        default:
+            if (step < n_path) {
+                xdr_err(w, "field path descends into a scalar");
+                return -1;
+            }
+            return idx;
+        }
+    }
+}
+
+static int
+parse_path_arg(PyObject *path, long long *out, int *n_out)
+{
+    if (!PyTuple_Check(path)) {
+        PyErr_SetString(PyExc_TypeError, "path must be a tuple of ints");
+        return -1;
+    }
+    Py_ssize_t n = PyTuple_GET_SIZE(path);
+    if (n > MAX_FIELD_PATH) {
+        PyErr_SetString(PyExc_ValueError, "field path too deep");
+        return -1;
+    }
+    for (Py_ssize_t i = 0; i < n; i++) {
+        out[i] = PyLong_AsLongLong(PyTuple_GET_ITEM(path, i));
+        if (out[i] == -1 && PyErr_Occurred())
+            return -1;
+    }
+    *n_out = (int)n;
+    return 0;
+}
+
+static PyObject *
+cxdr_getfield(PyObject *self, PyObject *args)
+{
+    PyObject *cap, *path;
+    Py_buffer data;
+    if (!PyArg_ParseTuple(args, "Oy*O", &cap, &data, &path))
+        return NULL;
+    Program *p = PyCapsule_GetPointer(cap, "cxdrpack.program");
+    long long steps[MAX_FIELD_PATH];
+    int n_steps;
+    if (!p || parse_path_arg(path, steps, &n_steps) < 0) {
+        PyBuffer_Release(&data);
+        return NULL;
+    }
+    Walk w;
+    memset(&w, 0, sizeof w);
+    w.prog = p;
+    Rd rd = {data.buf, data.len, 0};
+    int idx = walk_path(&w, &rd, steps, n_steps);
+    PyObject *out = NULL;
+    if (idx == -2) {
+        out = Py_None;
+        Py_INCREF(out);
+    } else if (idx >= 0) {
+        Node *nd = &p->nodes[idx];
+        switch (nd->kind) {
+        case K_U32: case K_I32: case K_U64: case K_I64: case K_BOOL:
+        case K_ENUM: case K_OPAQUE: case K_VAROPAQUE: case K_STRING:
+            out = unpack_node(&w, idx, &rd);
+            break;
+        default:
+            xdr_err(&w, "field path does not end at a scalar");
+        }
+    }
+    PyBuffer_Release(&data);
+    return out;
+}
+
+static PyObject *
+cxdr_setfield(PyObject *self, PyObject *args)
+{
+    PyObject *cap, *path, *val;
+    Py_buffer data;
+    if (!PyArg_ParseTuple(args, "Oy*OO", &cap, &data, &path, &val))
+        return NULL;
+    Program *p = PyCapsule_GetPointer(cap, "cxdrpack.program");
+    long long steps[MAX_FIELD_PATH];
+    int n_steps;
+    if (!p || parse_path_arg(path, steps, &n_steps) < 0) {
+        PyBuffer_Release(&data);
+        return NULL;
+    }
+    Walk w;
+    memset(&w, 0, sizeof w);
+    w.prog = p;
+    Rd rd = {data.buf, data.len, 0};
+    int idx = walk_path(&w, &rd, steps, n_steps);
+    if (idx == -2) {
+        xdr_err(&w, "cannot set a field behind an absent option");
+        idx = -1;
+    }
+    if (idx < 0) {
+        PyBuffer_Release(&data);
+        return NULL;
+    }
+    /* fixed-width terminals only: the patch must not change the length */
+    Node *nd = &p->nodes[idx];
+    char patch[8];
+    Py_ssize_t width = 0;
+    switch (nd->kind) {
+    case K_U32: {
+        unsigned long long v;
+        if (as_ulonglong(&w, val, &v, "uint32") < 0)
+            break;
+        if (v > 0xFFFFFFFFULL) {
+            xdr_err(&w, "uint32 out of range: %llu", v);
+            break;
+        }
+        put_be32(patch, (unsigned int)v);
+        width = 4;
+        break;
+    }
+    case K_I32: {
+        long long v;
+        if (as_longlong(&w, val, &v, "int32") < 0)
+            break;
+        if (v < -2147483648LL || v > 2147483647LL) {
+            xdr_err(&w, "int32 out of range: %lld", v);
+            break;
+        }
+        put_be32(patch, (unsigned int)(long)v);
+        width = 4;
+        break;
+    }
+    case K_U64: {
+        unsigned long long v;
+        if (as_ulonglong(&w, val, &v, "uint64") < 0)
+            break;
+        put_be64(patch, v);
+        width = 8;
+        break;
+    }
+    case K_I64: {
+        long long v;
+        if (as_longlong(&w, val, &v, "int64") < 0)
+            break;
+        put_be64(patch, (unsigned long long)v);
+        width = 8;
+        break;
+    }
+    case K_BOOL: {
+        int t = PyObject_IsTrue(val);
+        if (t < 0)
+            break;
+        put_be32(patch, t ? 1u : 0u);
+        width = 4;
+        break;
+    }
+    case K_ENUM: {
+        long long v;
+        if (as_longlong(&w, val, &v, "enum") < 0)
+            break;
+        int has = PyDict_Contains(nd->members, val);
+        if (has < 0)
+            break;
+        if (!has) {
+            xdr_err(&w, "bad enum value %lld", v);
+            break;
+        }
+        put_be32(patch, (unsigned int)(long)v);
+        width = 4;
+        break;
+    }
+    case K_OPAQUE: {
+        /* patched in place below from the buffer (can exceed 8 bytes) */
+        Py_buffer b;
+        if (PyObject_GetBuffer(val, &b, PyBUF_SIMPLE) < 0) {
+            PyErr_Clear();
+            xdr_err(&w, "opaque[%lld]: bytes expected, got %.80s",
+                    nd->a, Py_TYPE(val)->tp_name);
+            break;
+        }
+        if (b.len != nd->a) {
+            PyBuffer_Release(&b);
+            xdr_err(&w, "opaque[%lld] got %zd bytes", nd->a, b.len);
+            break;
+        }
+        if (rd.off + nd->a > rd.len) {
+            PyBuffer_Release(&b);
+            xdr_err(&w, "short buffer for opaque");
+            break;
+        }
+        PyObject *out = PyBytes_FromStringAndSize(
+            (const char *)data.buf, data.len);
+        if (out)
+            memcpy(PyBytes_AS_STRING(out) + rd.off, b.buf, nd->a);
+        PyBuffer_Release(&b);
+        PyBuffer_Release(&data);
+        return out;
+    }
+    default:
+        xdr_err(&w, "setfield terminal must be a fixed-width scalar");
+    }
+    if (!width) {
+        PyBuffer_Release(&data);
+        return NULL;
+    }
+    if (rd.off + width > rd.len) {
+        xdr_err(&w, "short buffer for scalar");
+        PyBuffer_Release(&data);
+        return NULL;
+    }
+    PyObject *out = PyBytes_FromStringAndSize((const char *)data.buf,
+                                              data.len);
+    if (out)
+        memcpy(PyBytes_AS_STRING(out) + rd.off, patch, width);
+    PyBuffer_Release(&data);
+    return out;
+}
+
 /* ---------------------------------------------------------------- */
 
 static void
@@ -1154,6 +1606,13 @@ static PyMethodDef methods[] = {
     {"unpack", cxdr_unpack, METH_VARARGS,
      "unpack(program, bytes) -> decoded value; XdrError on malformed or"
      " trailing bytes"},
+    {"getfield", cxdr_getfield, METH_VARARGS,
+     "getfield(program, bytes, path_tuple) -> scalar at the field path"
+     " (None for an absent option); XdrError on malformed bytes, union"
+     " arm mismatch, or a non-scalar path"},
+    {"setfield", cxdr_setfield, METH_VARARGS,
+     "setfield(program, bytes, path_tuple, value) -> new bytes with the"
+     " fixed-width scalar at the field path patched in place"},
     {NULL, NULL, 0, NULL},
 };
 

@@ -243,9 +243,14 @@ class TransactionFrame:
         """(Re)load the tx source into signing_account.  readonly skips
         the defensive cache copy — validation-path loads (check_valid /
         txset chain checks) only read; the apply path reloads mutable via
-        common_valid(applying=True) and process_fee_seq_num."""
+        common_valid(applying=True) and process_fee_seq_num.
+
+        signing=True routes through the close's FrameContext identity map
+        (ledger/framecontext.py): fee charging and validity-at-apply get
+        the SAME frame instead of a copy per load — the one aliasing the
+        reference itself has (mSigningAccount)."""
         self.signing_account = AccountFrame.load_account(
-            self.get_source_id(), db, readonly=readonly
+            self.get_source_id(), db, readonly=readonly, signing=True
         )
         return self.signing_account
 

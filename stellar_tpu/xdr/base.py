@@ -42,6 +42,8 @@ __all__ = [
     "unpack",
     "xdr_copy",
     "xdr_to_opaque",
+    "xdr_getfield",
+    "xdr_setfield",
 ]
 
 
@@ -961,3 +963,214 @@ def _cspec_of(codec: XdrCodec, defs: List[Any], memo: Dict[int, int]) -> int:
         raise _CUnsupported(type(codec).__name__)
     defs[idx] = spec
     return idx
+
+
+# -- hot-field accessors (C getfield/setfield over raw XDR bytes) -----------
+#
+# Read or patch ONE scalar field of a packed value without a full unpack:
+# the C interpreter (native/cxdrpack.c getfield/setfield) walks the same
+# compiled spec the pack/copy/unpack fast paths use, skipping everything
+# off the field path.  Shaped like the other interpreters: same program
+# capsule, same XdrError failure contract, pinned by the fuzzed
+# differential suite (tests/test_cxdrpack.py).  Paths are resolved ONCE
+# per (codec, path) against the declarative codec tree — struct fields by
+# name, union arms by discriminant (mismatch on the wire raises), array
+# elements by index; option/DepthLimited wrappers are transparent, and an
+# absent option on the path reads as None.  Hosts without the C toolchain
+# fall back to unpack + attribute walk (+ repack for setfield) — slower,
+# same results.
+
+_FIELD_PATH_MEMO: Dict[Tuple[int, tuple], tuple] = {}
+
+
+def _normalize_field_path(path) -> tuple:
+    if isinstance(path, str):
+        parts: tuple = tuple(path.split("."))
+    elif isinstance(path, (tuple, list)):
+        parts = tuple(path)
+    else:
+        parts = (path,)
+    out = []
+    for p in parts:
+        if isinstance(p, str) and p.lstrip("-").isdigit():
+            p = int(p)
+        out.append(p)
+    return tuple(out)
+
+
+def _resolve_field_path(codec: XdrCodec, path: tuple):
+    """(C step ints, terminal codec) for `path` rooted at `codec`."""
+    steps = []
+    cur = codec
+    for elt in path:
+        while isinstance(cur, (DepthLimited, _Option)):
+            cur = cur.inner if isinstance(cur, DepthLimited) else cur.elem
+        if isinstance(cur, _StructCodec):
+            if not isinstance(elt, str):
+                raise TypeError(
+                    f"struct step must be a field name, got {elt!r}"
+                )
+            for i, (n, c) in enumerate(cur.fields):
+                if n == elt:
+                    steps.append(i)
+                    cur = c
+                    break
+            else:
+                raise KeyError(
+                    f"{cur.cls.__name__} has no field {elt!r}"
+                )
+        elif isinstance(cur, _UnionCodec):
+            if isinstance(elt, str):
+                raise TypeError(
+                    f"union step must be a discriminant, got {elt!r}"
+                )
+            disc = int(elt)
+            arm = _MISSING_ARM
+            for d, c in cur.arms.items():
+                if int(d) == disc:
+                    arm = c
+                    break
+            if arm is _MISSING_ARM or arm is None:
+                raise KeyError(
+                    f"{cur.cls.__name__}: no data arm for discriminant"
+                    f" {disc}"
+                )
+            steps.append(disc)
+            cur = arm
+        elif isinstance(cur, (_Array, _VarArray)):
+            steps.append(int(elt))
+            cur = cur.elem
+        else:
+            raise TypeError(
+                f"field path descends into a scalar at {elt!r}"
+            )
+    return tuple(steps), cur
+
+
+_MISSING_ARM = object()
+
+
+def _field_path_of(codec: XdrCodec, path) -> tuple:
+    norm = _normalize_field_path(path)
+    key = (id(codec), norm)
+    hit = _FIELD_PATH_MEMO.get(key)
+    if hit is None:
+        hit = (_resolve_field_path(codec, norm)[0], norm)
+        _FIELD_PATH_MEMO[key] = hit
+    return hit
+
+
+def _py_walk(obj, norm: tuple):
+    """Python-fallback (and oracle) walk over a DECODED value."""
+    for elt in norm:
+        if obj is None:
+            return None  # absent option on the path
+        if isinstance(elt, str):
+            obj = getattr(obj, elt)
+        elif hasattr(obj, "type") and hasattr(obj, "value") and not isinstance(
+            obj, (list, bytes)
+        ):
+            if int(obj.type) != int(elt):
+                raise XdrError(
+                    f"union arm mismatch: value carries {int(obj.type)},"
+                    f" path expects {int(elt)}"
+                )
+            obj = obj.value
+        else:
+            try:
+                obj = obj[int(elt)]
+            except IndexError:
+                raise XdrError(
+                    f"array index {int(elt)} out of range"
+                ) from None
+    return obj
+
+
+def _cprog_for(codec: XdrCodec):
+    prog = codec._cprog
+    if prog is None:
+        prog = codec._compile_cprog()
+    return prog
+
+
+def xdr_getfield(cls_or_codec, data: bytes, path):
+    """The scalar at `path` inside the packed value `data` — without a
+    full unpack when the C interpreter is available.  `path` is a dotted
+    string or tuple: struct fields by name, union arms by discriminant
+    (int/IntEnum), array elements by index.  Absent options read as None.
+
+    NOT a validator: only the bytes on the path are bounds-checked; a
+    value that is malformed OFF the path can still answer.  Anything that
+    must reject malformed input keeps calling ``unpack``."""
+    codec = cls_or_codec if isinstance(cls_or_codec, XdrCodec) else codec_of(
+        cls_or_codec
+    )
+    steps, norm = _field_path_of(codec, path)
+    prog = _cprog_for(codec)
+    if prog is not False:
+        return _cxdr().getfield(prog, data, steps)
+    return _py_walk(codec.unpack(data), norm)
+
+
+def xdr_setfield(cls_or_codec, data: bytes, path, value) -> bytes:
+    """New bytes with the FIXED-WIDTH scalar at `path` patched in place
+    (ints, bools, enums, opaque[n]) — no unpack/repack round trip on the
+    C path.  Raises XdrError for variable-width terminals, out-of-range
+    values, union-arm mismatches, or truncated buffers."""
+    codec = cls_or_codec if isinstance(cls_or_codec, XdrCodec) else codec_of(
+        cls_or_codec
+    )
+    steps, norm = _field_path_of(codec, path)
+    prog = _cprog_for(codec)
+    if prog is not False:
+        return _cxdr().setfield(prog, data, steps, value)
+    # fallback: decode, set, re-encode (same octets, slower)
+    obj = codec.unpack(data)
+    if len(norm) == 0:
+        raise XdrError("empty field path")
+    parent = _py_walk(obj, norm[:-1])
+    if parent is None:
+        raise XdrError("cannot set a field behind an absent option")
+    last = norm[-1]
+    if isinstance(last, str):
+        object.__setattr__(parent, last, value)
+    elif isinstance(parent, list):
+        parent[int(last)] = value
+    else:
+        if int(parent.type) != int(last):
+            raise XdrError(
+                f"union arm mismatch: value carries {int(parent.type)},"
+                f" path expects {int(last)}"
+            )
+        object.__setattr__(parent, "value", value)
+    return codec.pack(obj)
+
+
+def iter_scalar_field_paths(codec: XdrCodec, val):
+    """Yield (path, leaf_codec, value) for every scalar leaf reachable in
+    the DECODED value `val` — paths in xdr_getfield/xdr_setfield shape
+    (struct names, union discriminants, array indices; options and depth
+    guards transparent).  Shared by the fuzzer's structured single-field
+    mutants and the accessor differential tests, so the one walker stays
+    in lockstep with the path grammar it feeds."""
+    while isinstance(codec, DepthLimited):
+        codec = codec.inner
+    if isinstance(codec, _Option):
+        if val is None:
+            return
+        codec = codec.elem
+    if isinstance(codec, _StructCodec):
+        for name, c in codec.fields:
+            for p, leaf, v in iter_scalar_field_paths(c, getattr(val, name)):
+                yield (name,) + p, leaf, v
+    elif isinstance(codec, _UnionCodec):
+        arm = codec.arms.get(val.type)
+        if arm is not None:
+            for p, leaf, v in iter_scalar_field_paths(arm, val.value):
+                yield (int(val.type),) + p, leaf, v
+    elif isinstance(codec, (_Array, _VarArray)):
+        for i, item in enumerate(val):
+            for p, leaf, v in iter_scalar_field_paths(codec.elem, item):
+                yield (i,) + p, leaf, v
+    else:
+        yield (), codec, val

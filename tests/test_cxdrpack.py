@@ -350,3 +350,199 @@ class TestFailureContract:
         val.flags = -1  # uint32 field
         with pytest.raises(XdrError):
             codec_of(val).pack(val)
+
+
+# -- hot-field accessors (getfield/setfield, round 7) -----------------------
+
+
+def _scalar_paths_of(codec, val):
+    """Every scalar field path in a decoded value with its oracle value —
+    the shared walker (xdr/base.py iter_scalar_field_paths), filtered to
+    non-root paths (the root itself isn't a field)."""
+    from stellar_tpu.xdr.base import iter_scalar_field_paths
+
+    for path, _leaf, v in iter_scalar_field_paths(codec, val):
+        if path:
+            yield path, v
+
+
+@pytest.mark.parametrize("cls", TYPES, ids=lambda c: c.__name__)
+def test_getfield_matches_attribute_walk(cls):
+    """Fuzzed differential: for every scalar path of every registered
+    type, the C byte-walker answers exactly what the decoded object
+    holds."""
+    from stellar_tpu.xdr.base import xdr_getfield
+
+    rng = random.Random(_seed(cls) ^ 3)
+    codec = codec_of(cls)
+    checked = 0
+    for _ in range(8):
+        val = arbitrary.arbitrary(codec, size=6, rng=rng)
+        try:
+            data = _py_pack(codec, val)
+        except XdrError:
+            continue
+        for path, want in _scalar_paths_of(codec, val):
+            got = xdr_getfield(codec, data, path)
+            assert got == want, (cls.__name__, path)
+            checked += 1
+    if checked == 0:
+        pytest.skip(f"{cls.__name__}: no scalar paths in fuzzed values")
+
+
+def test_getfield_absent_option_is_none():
+    from stellar_tpu.xdr.base import xdr_getfield
+    from stellar_tpu.xdr.entries import AccountEntry
+
+    val = arbitrary.arbitrary_of(AccountEntry, size=4, rng=random.Random(9))
+    val.inflationDest = None
+    data = _py_pack(codec_of(val), val)
+    assert xdr_getfield(AccountEntry, data, "inflationDest") is None
+
+
+def test_setfield_differential_vs_repack():
+    """Patching a fixed-width scalar in the bytes must equal setattr +
+    full repack, for every fixed-width path of a fuzzed LedgerEntry."""
+    from stellar_tpu.xdr import base as B
+    from stellar_tpu.xdr.base import xdr_setfield
+    from stellar_tpu.xdr.entries import LedgerEntry
+
+    rng = random.Random(31)
+    codec = codec_of(LedgerEntry)
+    for _ in range(10):
+        val = arbitrary.arbitrary(codec, size=6, rng=rng)
+        data = _py_pack(codec, val)
+        for path, _old in _scalar_paths_of(codec, val):
+            steps, norm = B._field_path_of(codec, path)
+            _, leaf = B._resolve_field_path(codec, norm)
+            if isinstance(leaf, B._UInt32):
+                new = rng.getrandbits(32)
+            elif isinstance(leaf, B._Int64):
+                new = rng.getrandbits(62)
+            elif isinstance(leaf, B._UInt64):
+                new = rng.getrandbits(64)
+            elif isinstance(leaf, B._Int32):
+                new = rng.getrandbits(30)
+            elif isinstance(leaf, B._Bool):
+                new = True
+            elif isinstance(leaf, B._Enum):
+                new = rng.choice(list(leaf.enum_cls))
+            elif isinstance(leaf, B._Opaque):
+                new = bytes(rng.getrandbits(8) for _ in range(leaf.n))
+            else:
+                continue  # var-width (string/varopaque): not patchable
+            got = xdr_setfield(codec, data, path, new)
+            # oracle: decode, set via the same walk, repack
+            obj = codec.unpack(data)
+            parent = B._py_walk(obj, norm[:-1])
+            last = norm[-1]
+            if isinstance(last, str):
+                object.__setattr__(parent, last, new)
+            elif isinstance(parent, list):
+                parent[last] = new
+            else:
+                object.__setattr__(parent, "value", new)
+            assert got == _py_pack(codec, obj), path
+
+
+class TestFieldAccessHostilePaths:
+    def _payload(self):
+        from stellar_tpu.xdr.entries import LedgerEntry
+
+        codec = codec_of(LedgerEntry)
+        val = arbitrary.arbitrary(codec, size=5, rng=random.Random(41))
+        return codec, _py_pack(codec, val), val
+
+    def test_truncated_buffers(self):
+        from stellar_tpu.xdr.base import xdr_getfield
+
+        codec, data, val = self._payload()
+        path = ("data", int(val.data.type), "flags")
+        oracle = xdr_getfield(codec, data, path)
+        for cut in range(0, len(data), 3):
+            # every truncation either raises a clean XdrError, or the walk
+            # legitimately completed before the cut — in which case the
+            # answer must be THE true value (a bounds bug returning bytes
+            # read past the cut would produce garbage and fail here)
+            try:
+                got = xdr_getfield(codec, data[:cut], path)
+            except XdrError:
+                continue
+            assert got == oracle, f"cut {cut}: wrong value from truncation"
+
+    def test_union_arm_mismatch(self):
+        from stellar_tpu.xdr.base import xdr_getfield
+        from stellar_tpu.xdr.entries import LedgerEntryType
+
+        codec, data, val = self._payload()
+        wrong = (
+            LedgerEntryType.TRUSTLINE
+            if val.data.type != LedgerEntryType.TRUSTLINE
+            else LedgerEntryType.OFFER
+        )
+        field = "balance" if wrong == LedgerEntryType.TRUSTLINE else "amount"
+        with pytest.raises(XdrError, match="arm mismatch"):
+            xdr_getfield(codec, data, ("data", int(wrong), field))
+
+    def test_void_arm_and_unknown_field_fail_at_resolve(self):
+        from stellar_tpu.xdr.base import xdr_getfield
+        import stellar_tpu.xdr as X
+
+        a = X.Asset.native()
+        data = codec_of(a).pack(a)
+        with pytest.raises(KeyError):  # native arm is void
+            xdr_getfield(codec_of(a), data, (int(X.AssetType.ASSET_TYPE_NATIVE),))
+        codec, payload, _ = self._payload()
+        with pytest.raises(KeyError):
+            xdr_getfield(codec, payload, "noSuchField")
+
+    def test_path_into_scalar_rejected(self):
+        from stellar_tpu.xdr.base import xdr_getfield
+
+        codec, data, _ = self._payload()
+        with pytest.raises(TypeError):
+            xdr_getfield(codec, data, "lastModifiedLedgerSeq.x")
+
+    def test_array_index_out_of_range(self):
+        from stellar_tpu.xdr.base import xdr_getfield
+        from stellar_tpu.xdr.entries import (
+            AccountEntry, LedgerEntry, LedgerEntryData, LedgerEntryType,
+            PublicKey, Signer,
+        )
+
+        ae = arbitrary.arbitrary_of(AccountEntry, size=3,
+                                    rng=random.Random(5))
+        ae.signers = [Signer(PublicKey.from_ed25519(b"\x01" * 32), 1)]
+        le = LedgerEntry(0, LedgerEntryData(LedgerEntryType.ACCOUNT, ae), 0)
+        data = _py_pack(codec_of(le), le)
+        path = ("data", int(LedgerEntryType.ACCOUNT), "signers", 5, "weight")
+        with pytest.raises(XdrError, match="out of range"):
+            xdr_getfield(codec_of(le), data, path)
+
+    def test_setfield_rejects_varwidth_and_bad_values(self):
+        from stellar_tpu.xdr.base import xdr_setfield
+        from stellar_tpu.xdr.entries import LedgerEntryType
+
+        codec, data, val = self._payload()
+        arm = int(val.data.type)
+        if val.data.type == LedgerEntryType.ACCOUNT:
+            with pytest.raises(XdrError, match="fixed-width"):
+                xdr_setfield(codec, data, ("data", arm, "homeDomain"), "x")
+            with pytest.raises(XdrError):  # uint32 out of range
+                xdr_setfield(codec, data, ("data", arm, "flags"), -1)
+            with pytest.raises(XdrError):  # opaque[4] wrong length
+                xdr_setfield(codec, data, ("data", arm, "thresholds"), b"xy")
+        with pytest.raises(XdrError):  # truncated buffer
+            xdr_setfield(codec, data[:3], ("lastModifiedLedgerSeq",), 1)
+
+    def test_setfield_patch_is_surgical(self):
+        """Only the patched field differs; everything else is bitwise
+        untouched (the whole point: no repack of the rest)."""
+        from stellar_tpu.xdr.base import xdr_setfield
+
+        codec, data, val = self._payload()
+        out = xdr_setfield(codec, data, ("lastModifiedLedgerSeq",), 0x0A0B0C0D)
+        assert len(out) == len(data)
+        diff = [i for i, (x, y) in enumerate(zip(data, out)) if x != y]
+        assert diff and max(diff) - min(diff) < 4, "patch must stay in-field"
+        assert codec.unpack(out).lastModifiedLedgerSeq == 0x0A0B0C0D

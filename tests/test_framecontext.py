@@ -1,0 +1,380 @@
+"""Close-scoped frame identity map (ledger/framecontext.py).
+
+The FrameContext hands out ONE AccountFrame per touched account per close;
+the reference loads a fresh frame per touch.  The contract is therefore
+equivalence: a node with FRAME_CONTEXT=on must produce bit-identical
+ledgers, bit-identical SQL state, AND bit-identical tx/fee history rows
+(including the per-op LedgerEntryChanges metas) to one with it off — for
+payments, fee charging, failed-tx rollbacks, same-close create+pay chains,
+signer mutations, merges, offer crossings, and inflation.  PARANOID_MODE
+audits every close on both sides.
+
+Mechanics tests below pin the map itself: identity, savepoint-lockstep
+eviction, the readonly-shell store guard, and the stale-context refusal.
+"""
+
+import pytest
+
+import stellar_tpu.xdr as X
+from stellar_tpu.main.application import Application
+from stellar_tpu.tx import testutils as T
+from stellar_tpu.util import VIRTUAL_TIME, VirtualClock
+
+RC = X.TransactionResultCode
+
+
+@pytest.fixture
+def clock():
+    c = VirtualClock(VIRTUAL_TIME)
+    yield c
+    c.shutdown()
+
+
+def _dump_state(db):
+    """Entry tables + the history planes (txmeta/txchanges columns carry
+    the XDR'd LedgerEntryChanges — the delta-meta half of the contract)."""
+    out = {}
+    for table, order in (
+        ("accounts", "accountid"),
+        ("signers", "accountid, publickey"),
+        ("trustlines", "accountid, issuer, assetcode"),
+        ("offers", "offerid"),
+        ("txhistory", "ledgerseq, txindex"),
+        ("txfeehistory", "ledgerseq, txindex"),
+    ):
+        out[table] = db.query_all(f"SELECT * FROM {table} ORDER BY {order}")
+    return out
+
+
+class _Runner:
+    """Drive the same close sequence through two apps (frame context on /
+    off) and compare ledger hashes + SQL + history after every close."""
+
+    def __init__(self, clock, instance_base):
+        self.apps = []
+        for i, fc in enumerate((True, False)):
+            cfg = T.get_test_config(instance_base + i)
+            cfg.FRAME_CONTEXT = fc
+            cfg.PARANOID_MODE = True  # audit every close on both sides
+            self.apps.append(Application(clock, cfg, new_db=True))
+
+    def close(self, build_txs):
+        results = []
+        for app in self.apps:
+            lm = app.ledger_manager
+            txs = build_txs(app, T.root_key_for(app))
+            T.close_ledger_on(
+                app, lm.last_closed.header.scpValue.closeTime + 5, txs
+            )
+            results.append([tx.get_result_code() for tx in txs])
+        fc_app, ref_app = self.apps
+        assert results[0] == results[1], "tx result codes diverged"
+        assert (
+            fc_app.ledger_manager.last_closed.hash
+            == ref_app.ledger_manager.last_closed.hash
+        ), "ledger hash diverged"
+        assert _dump_state(fc_app.database) == _dump_state(
+            ref_app.database
+        ), "SQL state (entries or history metas) diverged"
+        return results[0]
+
+    def shutdown(self):
+        for app in self.apps:
+            app.database.close()
+
+
+@pytest.fixture
+def runner(clock):
+    r = _Runner(clock, 72)
+    yield r
+    r.shutdown()
+
+
+def _seq(app, sk):
+    from stellar_tpu.ledger.accountframe import AccountFrame
+
+    return AccountFrame.load_account(
+        sk.get_public_key(), app.database
+    ).get_seq_num() + 1
+
+
+def test_differential_payments_fees_and_rollback(runner):
+    """The benchmark shape plus a mid-close failed tx: the failed tx's
+    frame mutations must unwind from the identity map in lockstep with
+    the savepoint (its meta must also be byte-identical: empty)."""
+    a, b = T.get_account("fc-a"), T.get_account("fc-b")
+    runner.close(lambda app, root: [
+        T.tx_from_ops(app, root, _seq(app, root), [
+            T.create_account_op(a, 10**12), T.create_account_op(b, 10**12),
+        ]),
+    ])
+    codes = runner.close(lambda app, root: [
+        T.tx_from_ops(app, a, _seq(app, a), [T.payment_op(b, 10**7)]),
+        T.tx_from_ops(app, b, _seq(app, b), [T.payment_op(a, 3 * 10**6)]),
+        # failed tx: underfunded payment rolls back mid-close — the source
+        # frame was fee-charged (stored) then mutated in the aborted apply
+        T.tx_from_ops(app, a, _seq(app, a) + 1, [T.payment_op(b, 10**15)]),
+    ])
+    assert codes[:2] == [RC.txSUCCESS, RC.txSUCCESS]
+    assert codes[2] == RC.txFAILED
+    # and the next close still agrees (post-rollback frame state clean)
+    codes = runner.close(lambda app, root: [
+        T.tx_from_ops(app, a, _seq(app, a), [T.payment_op(b, 10**6)]),
+    ])
+    assert codes == [RC.txSUCCESS]
+
+
+def test_differential_create_then_pay_same_close(runner):
+    """An account created by tx1 is the payment destination of tx2 in the
+    SAME close: the context must converge on the frame tx1 stored."""
+    c = T.get_account("fc-new")
+    codes = runner.close(lambda app, root: [
+        T.tx_from_ops(app, root, _seq(app, root),
+                      [T.create_account_op(c, 10**11)]),
+        T.tx_from_ops(app, root, _seq(app, root) + 1,
+                      [T.payment_op(c, 10**7)]),
+    ])
+    assert codes == [RC.txSUCCESS, RC.txSUCCESS]
+
+
+def test_differential_self_path_payment(runner):
+    """destination == source PATH payment (native, empty path) — the op
+    holds TWO handles to one account and interleaves credit/store/debit/
+    store.  The reference aliases only the signing handle: the fresh
+    destination snapshot's credit is overwritten by the stale source
+    handle's debit.  The identity map must reproduce that exactly (it
+    serves ONLY signing loads), not 'fix' it — a node that kept the
+    credit would fork from the network."""
+    a = T.get_account("fc-selfpp")
+    runner.close(lambda app, root: [
+        T.tx_from_ops(app, root, _seq(app, root),
+                      [T.create_account_op(a, 10**11)]),
+    ])
+    codes = runner.close(lambda app, root: [
+        T.tx_from_ops(app, a, _seq(app, a), [
+            T.op(
+                X.OperationType.PATH_PAYMENT,
+                X.PathPaymentOp(
+                    sendAsset=X.Asset.native(),
+                    sendMax=10**7,
+                    destination=a.get_public_key(),
+                    destAsset=X.Asset.native(),
+                    destAmount=10**7,
+                    path=[],
+                ),
+            ),
+        ]),
+    ])
+    assert codes == [RC.txSUCCESS]
+
+
+def test_differential_signers_merge_inflation(runner):
+    a, b = T.get_account("fc-sig"), T.get_account("fc-victim")
+    s1 = T.get_account("fc-signer")
+    runner.close(lambda app, root: [
+        T.tx_from_ops(app, root, _seq(app, root), [
+            T.create_account_op(a, 10**12), T.create_account_op(b, 10**11),
+        ]),
+    ])
+    codes = runner.close(lambda app, root: [
+        T.tx_from_ops(app, a, _seq(app, a), [
+            T.set_options_op(signer=X.Signer(s1.get_public_key(), 1)),
+        ]),
+        # merge DELETES b mid-close: the identity map must evict, not
+        # resurrect, the deleted account
+        T.tx_from_ops(app, b, _seq(app, b), [T.merge_op(a)]),
+    ])
+    assert codes == [RC.txSUCCESS, RC.txSUCCESS]
+    codes = runner.close(lambda app, root: [
+        T.tx_from_ops(app, a, _seq(app, a), [
+            T.set_options_op(inflation_dest=a.get_public_key()),
+        ]),
+        T.tx_from_ops(app, root, _seq(app, root), [T.inflation_op()]),
+    ])
+    assert codes[0] == RC.txSUCCESS
+
+
+def test_differential_offer_crossing(runner):
+    """Order-book crossing in one close: account balances mutate through
+    shared frames while offers ride the normal (context-less) path."""
+    a, b = T.get_account("fc-sell"), T.get_account("fc-buy")
+    runner.close(lambda app, root: [
+        T.tx_from_ops(app, root, _seq(app, root), [
+            T.create_account_op(a, 10**12), T.create_account_op(b, 10**12),
+        ]),
+    ])
+
+    def mk_usd(app):
+        return X.Asset.alphanum4(b"USD", T.root_key_for(app).get_public_key())
+
+    runner.close(lambda app, root: [
+        T.tx_from_ops(app, a, _seq(app, a),
+                      [T.change_trust_op(mk_usd(app), 10**12)]),
+        T.tx_from_ops(app, b, _seq(app, b),
+                      [T.change_trust_op(mk_usd(app), 10**12)]),
+    ])
+    runner.close(lambda app, root: [
+        T.tx_from_ops(app, root, _seq(app, root), [
+            T.payment_op(b, 10**10, asset=mk_usd(app)),
+        ]),
+    ])
+    codes = runner.close(lambda app, root: [
+        T.tx_from_ops(app, a, _seq(app, a), [
+            T.manage_offer_op(X.Asset.native(), mk_usd(app), 10**8,
+                              X.Price(2, 1)),
+        ]),
+        T.tx_from_ops(app, b, _seq(app, b), [
+            T.manage_offer_op(mk_usd(app), X.Asset.native(), 10**8,
+                              X.Price(1, 2)),
+        ]),
+    ])
+    assert codes == [RC.txSUCCESS, RC.txSUCCESS]
+
+
+class TestContextMechanics:
+    def _ctx(self):
+        from stellar_tpu.ledger.framecontext import FrameContext
+
+        return FrameContext()
+
+    def test_identity_and_rollback_eviction(self):
+        ctx = self._ctx()
+        ctx.activate()
+
+        class F:
+            _ctx = None
+
+        f = F()
+        ctx.adopt(b"k1", f)
+        assert ctx.lend(b"k1", mutable=True) is f
+        # inside a savepoint: lent frames evict on rollback
+        ctx.push_mark()
+        assert ctx.lend(b"k1", mutable=True) is f
+        g = F()
+        ctx.adopt(b"k2", g)
+        ctx.rollback_mark()
+        assert ctx.lend(b"k1", mutable=True) is None, "lent frame evicted"
+        assert ctx.lend(b"k2", mutable=True) is None, "adopted frame evicted"
+        assert f._ctx is None and g._ctx is None
+        ctx.deactivate()
+
+    def test_release_keeps_outer_scope_accountable(self):
+        ctx = self._ctx()
+        ctx.activate()
+
+        class F:
+            _ctx = None
+
+        ctx.push_mark()   # outer savepoint
+        ctx.push_mark()   # inner savepoint
+        f = F()
+        ctx.adopt(b"k", f)
+        ctx.release_mark()   # inner commits into outer scope
+        ctx.rollback_mark()  # outer rolls back: inner's frame must evict
+        assert ctx.lend(b"k", mutable=True) is None
+        ctx.deactivate()
+
+    def test_close_hands_out_one_frame_per_account(self, clock):
+        """End-to-end: during a close, fee charging and apply observe the
+        same frame object (identity, not just equal state)."""
+        from stellar_tpu.ledger.accountframe import AccountFrame
+
+        cfg = T.get_test_config(76)
+        app = Application(clock, cfg, new_db=True)
+        try:
+            root = T.root_key_for(app)
+            a = T.get_account("fc-ident")
+            lm = app.ledger_manager
+            T.close_ledger_on(
+                app, lm.last_closed.header.scpValue.closeTime + 5,
+                [T.tx_from_ops(app, root, _seq(app, root),
+                               [T.create_account_op(a, 10**10)])],
+            )
+            seen = []
+            orig = AccountFrame.load_account.__func__
+
+            def spy(cls, account_id, db, readonly=False, signing=False):
+                f = orig(cls, account_id, db, readonly, signing)
+                ctx = getattr(db, "_frame_context", None)
+                # only in-close SIGNING loads count (the map serves the
+                # tx-source plane; tx building loads seqnums too)
+                if f is not None and ctx is not None and ctx.active \
+                        and signing and not readonly \
+                        and account_id == a.get_public_key():
+                    seen.append(f)
+                return f
+
+            AccountFrame.load_account = classmethod(spy)
+            try:
+                T.close_ledger_on(
+                    app, lm.last_closed.header.scpValue.closeTime + 5,
+                    [T.tx_from_ops(app, a, _seq(app, a),
+                                   [T.payment_op(root, 10**6)])],
+                )
+            finally:
+                AccountFrame.load_account = classmethod(orig)
+            assert len(seen) >= 2, "fee + apply must both load the source"
+            assert all(f is seen[0] for f in seen), (
+                "close must hand out ONE frame per account"
+            )
+            ctx = app.database._frame_context
+            assert ctx.hits > 0 and not ctx.active
+        finally:
+            app.database.close()
+
+    def test_readonly_shell_refuses_store(self, clock):
+        """A readonly load that hits the identity map gets a live-state
+        shell whose stores refuse — the validation plane cannot poison
+        the close's working frame or the entry cache."""
+        from stellar_tpu.ledger.accountframe import AccountFrame
+        from stellar_tpu.ledger.delta import LedgerDelta
+        from stellar_tpu.ledger.framecontext import frame_context_of
+
+        cfg = T.get_test_config(77)
+        app = Application(clock, cfg, new_db=True)
+        try:
+            root = T.root_key_for(app)
+            db = app.database
+            lm = app.ledger_manager
+            ctx = frame_context_of(db)
+            ctx.activate()
+            try:
+                pk = root.get_public_key()
+                f = AccountFrame.load_account(pk, db, signing=True)  # adopted
+                ro = AccountFrame.load_account(
+                    pk, db, readonly=True, signing=True
+                )
+                assert ro is not f and ro.entry is f.entry  # live shell
+                delta = LedgerDelta(lm.current.header, db)
+                with pytest.raises(RuntimeError, match="read-only"):
+                    ro.store_change(delta, db)
+            finally:
+                ctx.deactivate()
+        finally:
+            app.database.close()
+
+    def test_stale_context_frame_refuses_store(self, clock):
+        """A frame retained past its close cannot write into a later
+        ledger (the store_* refusal machinery extended to context-owned
+        frames)."""
+        from stellar_tpu.ledger.accountframe import AccountFrame
+        from stellar_tpu.ledger.delta import LedgerDelta
+        from stellar_tpu.ledger.framecontext import frame_context_of
+
+        cfg = T.get_test_config(78)
+        app = Application(clock, cfg, new_db=True)
+        try:
+            root = T.root_key_for(app)
+            db = app.database
+            lm = app.ledger_manager
+            ctx = frame_context_of(db)
+            ctx.activate()
+            f = AccountFrame.load_account(
+                root.get_public_key(), db, signing=True
+            )
+            ctx.deactivate()  # the close is over
+            delta = LedgerDelta(lm.current.header, db)
+            with pytest.raises(RuntimeError, match="stale close-scoped"):
+                f.store_change(delta, db)
+        finally:
+            app.database.close()

@@ -473,3 +473,37 @@ def test_private_addresses_not_exchanged(two_apps):
     assert PeerRecord.load(a.database, "192.168.0.9", 1) is None
     stored = PeerRecord.load(a.database, "9.9.9.9", 2)
     assert stored is not None and stored.num_failures == 0
+
+
+def test_legacy_hello_rejected_as_unhandled(two_apps):
+    """Legacy HELLO (reference Peer.cpp:159 marks it 'to be removed'; the
+    live handshake is HELLO2, Peer.cpp:949-1005): the repo deliberately
+    does not implement its acceptance — SWEEP.md records the skip — so
+    this pins the covering behavior: a wire-valid legacy HELLO reaching an
+    authenticated peer takes the unknown-message-type reject path (warn +
+    ignore, no dispatch, no crash, connection intact)."""
+    import stellar_tpu.xdr.overlay as OV
+
+    clock, a, b = two_apps
+    conn = LoopbackPeerConnection(a, b)
+    crank(clock)
+    assert conn.acceptor.is_authenticated()
+    cfg = a.config
+    legacy = OV.StellarMessage(
+        OV.MessageType.HELLO,
+        OV.Hello(
+            ledgerVersion=0,
+            overlayVersion=cfg.OVERLAY_PROTOCOL_VERSION,
+            networkID=a.network_id,
+            versionStr="legacy",
+            listeningPort=1,
+            peerID=cfg.NODE_SEED.get_public_key(),
+            cert=a.overlay_manager.peer_auth.get_auth_cert(),
+            nonce=b"\x01" * 32,
+        ),
+    )
+    conn.initiator.send_message(legacy)  # MAC'd + sequenced like any msg
+    crank(clock)
+    # unknown-type path: ignored without dropping the authenticated link
+    assert conn.acceptor.is_authenticated()
+    assert b.overlay_manager.get_authenticated_peer_count() == 1
