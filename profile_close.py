@@ -31,6 +31,11 @@ def _make_app(instance, n_txs, buffered=True, frame_context=True):
     cfg.DESIRED_MAX_TX_PER_LEDGER = n_txs * 2
     cfg.ENTRY_WRITE_BUFFER = buffered
     cfg.FRAME_CONTEXT = frame_context
+    # invariant plane in SAMPLED mode, matching bench.py: this harness's
+    # round-over-round p50s (and the close_budget regression gate) must
+    # stay comparable with pre-r08 numbers — the all-on cost is tracked
+    # separately as bench.py's invariant_overhead_ms
+    cfg.INVARIANT_SAMPLED = True
     clock = VirtualClock()
     return Application.create(clock, cfg, new_db=True), clock
 

@@ -78,6 +78,15 @@ FINAL_STEPS = [
      [sys.executable, "-u", "profile_close.py", "--assert-budget", "2000"],
      1200),
     ("bench_hoststage_r07", [sys.executable, "-u", "bench.py"], 1600),
+    # r08: certify the invariant plane's sampled-mode cost on the 500-tx
+    # acceptance shape (ISSUE r08: sampled overhead <= 5% of close p50) —
+    # close-stage only, so the step fits a short window; the JSON line
+    # carries invariant_overhead_ms {off/sampled/all_on} + pct-of-close
+    ("invariant_overhead_r08",
+     [sys.executable, "-u", "-c",
+      "import json, bench; r = bench.bench_ledger_close(n_txs=500, "
+      "n_ledgers=5); print(json.dumps(r))"],
+     900),
 ]
 ALL_NAMES = (
     [s[0] for s in SCRIPT_STEPS]

@@ -19,6 +19,8 @@ import time
 from contextlib import contextmanager
 from typing import Any, Iterable, List, Optional, Tuple
 
+from .dialect import dialect_for
+
 SCHEMA_VERSION = 1
 
 
@@ -33,6 +35,14 @@ class UnrollbackableWrite(RuntimeError):
 class Database:
     def __init__(self, connection_string: str = "sqlite3://:memory:", metrics=None):
         self.connection_string = connection_string
+        # backend-specific SQL surface (placeholder style, savepoint
+        # syntax, type mapping) — the postgres seam (database/dialect.py)
+        self.dialect = dialect_for(connection_string)
+        # placeholder rewrite hook: None on sqlite (identity) so the hot
+        # query paths pay one is-None check, not a call per statement
+        self._sql_translate = (
+            self.dialect.translate if self.dialect.placeholder != "?" else None
+        )
         path = self._parse(connection_string)
         self._conn = sqlite3.connect(path, isolation_level=None)
         self._conn.execute("PRAGMA journal_mode=MEMORY" if path == ":memory:"
@@ -59,7 +69,16 @@ class Database:
     # query_count feeds per-peer load attribution (overlay LoadManager)
     def execute(self, sql: str, params: Iterable = ()) -> sqlite3.Cursor:
         self.query_count += 1
+        if self._sql_translate is not None:
+            sql = self._sql_translate(sql)
         if not self._unmaterialized_scopes():
+            return self._conn.execute(sql, tuple(params))
+        if not self.dialect.statement_abort_credits_total_changes:
+            # this backend cannot attribute a FAILED statement's
+            # backed-out rows (no sqlite total_changes semantics), so the
+            # credit trick below is unsound for it: give every lazy scope
+            # a real savepoint before the direct write instead
+            self.materialize_savepoints()
             return self._conn.execute(sql, tuple(params))
         # Inside a savepoint-less buffered scope, a FAILED statement's row
         # changes were already backed out by sqlite's statement-level
@@ -89,14 +108,20 @@ class Database:
         # for whatever the batch wrote before failing.
         if self._unmaterialized_scopes():
             self.materialize_savepoints()
+        if self._sql_translate is not None:
+            sql = self._sql_translate(sql)
         return self._conn.executemany(sql, rows)
 
     def query_one(self, sql: str, params: Iterable = ()) -> Optional[Tuple]:
         self.query_count += 1
+        if self._sql_translate is not None:
+            sql = self._sql_translate(sql)
         return self._conn.execute(sql, tuple(params)).fetchone()
 
     def query_all(self, sql: str, params: Iterable = ()) -> List[Tuple]:
         self.query_count += 1
+        if self._sql_translate is not None:
+            sql = self._sql_translate(sql)
         return self._conn.execute(sql, tuple(params)).fetchall()
 
     # -- timed access (reference: getSelect/Insert/Update/DeleteTimer) ------
@@ -176,8 +201,8 @@ class Database:
                         fctx.rollback_mark()
                     sp, changes0 = self._lazy_sps.pop()
                     if sp is not None:
-                        self._conn.execute(f"ROLLBACK TO SAVEPOINT {sp}")
-                        self._conn.execute(f"RELEASE SAVEPOINT {sp}")
+                        self._conn.execute(self.dialect.rollback_to_sql(sp))
+                        self._conn.execute(self.dialect.release_sql(sp))
                     elif self._conn.total_changes != changes0:
                         # a genuinely materialized direct write: execute()
                         # credits statement-ABORT-backed-out rows against
@@ -198,11 +223,11 @@ class Database:
                         fctx.release_mark()
                     sp, _ = self._lazy_sps.pop()
                     if sp is not None:
-                        self._conn.execute(f"RELEASE SAVEPOINT {sp}")
+                        self._conn.execute(self.dialect.release_sql(sp))
                 return
             self._sp_counter += 1
             sp = f"sp_{self._sp_counter}"
-            self._conn.execute(f"SAVEPOINT {sp}")
+            self._conn.execute(self.dialect.savepoint_sql(sp))
             if fctx is not None:
                 # write-through mode (buffer off, real savepoints) still
                 # needs the identity map unwound on rollback
@@ -212,14 +237,14 @@ class Database:
                 yield self
             except BaseException:
                 self._tx_depth -= 1
-                self._conn.execute(f"ROLLBACK TO SAVEPOINT {sp}")
-                self._conn.execute(f"RELEASE SAVEPOINT {sp}")
+                self._conn.execute(self.dialect.rollback_to_sql(sp))
+                self._conn.execute(self.dialect.release_sql(sp))
                 if fctx is not None:
                     fctx.rollback_mark()
                 raise
             else:
                 self._tx_depth -= 1
-                self._conn.execute(f"RELEASE SAVEPOINT {sp}")
+                self._conn.execute(self.dialect.release_sql(sp))
                 if fctx is not None:
                     fctx.release_mark()
 
@@ -242,7 +267,7 @@ class Database:
                     )
                 self._sp_counter += 1
                 name = f"sp_{self._sp_counter}"
-                self._conn.execute(f"SAVEPOINT {name}")
+                self._conn.execute(self.dialect.savepoint_sql(name))
                 slot[0] = name
 
     @property

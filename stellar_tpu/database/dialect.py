@@ -1,0 +1,137 @@
+"""SQL dialect seam (ROADMAP #6 — the Postgres scope decision as code).
+
+The reference runs over SOCI with sqlite3 and postgresql backends
+(src/database/Database.cpp); this port is sqlite-only in this
+environment.  ``Database`` routes the backend-specific pieces of its
+statement flow through a ``Dialect`` object (``Database.dialect``):
+
+- savepoint statement syntax (``transaction()`` /
+  ``materialize_savepoints``);
+- placeholder rewriting — every execute/executemany/query path passes
+  through ``translate`` when the backend's placeholder is not ``?``
+  (identity-skipped on sqlite);
+- the statement-level-ABORT ``total_changes`` credit trick:
+  ``Database.execute`` applies it only when
+  ``statement_abort_credits_total_changes`` says the backend supports
+  it, and falls back to materializing real savepoints otherwise.
+
+``column_type`` is a recorded mapping, not yet a routed one — schema
+DDL is authored inline in the frame classes in generic type names that
+sqlite accepts as-is; a postgres backend additionally rewrites the
+CREATE TABLE corpus through ``column_type`` and the INSERT OR REPLACE
+batches into ON CONFLICT form (listed on ``PostgresDialect`` so the
+first live-postgres PR starts from a checklist, not archaeology).
+``CacheIsConsistentWithDatabase`` (stellar_tpu/invariant/) gets a
+second backend to run against the day one lands.
+
+``SqliteDialect`` is the shipped default; ``PostgresDialect`` captures
+the mapping decisions up front and is exercised by server-gated tests
+(tests/test_dialect.py: skipped unless ``STELLAR_TPU_PG_DSN`` points at
+a live server and a driver is importable — nothing is pip-installed for
+it).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+
+class Dialect:
+    """Backend-specific SQL surface.  Statement helpers return full SQL
+    strings; ``translate`` rewrites a qmark-parameterized statement into
+    the backend's placeholder style (identity on sqlite)."""
+
+    name = "?"
+    #: DB-API paramstyle of the backend's driver
+    paramstyle = "qmark"
+    placeholder = "?"
+    #: sqlite backs out a FAILED statement's row changes itself but still
+    #: counts them in total_changes — Database.execute credits them
+    #: against lazy-savepoint baselines.  Server backends without that
+    #: counter must materialize savepoints before direct writes instead.
+    statement_abort_credits_total_changes = False
+    #: generic -> backend column type (only the types our schemas use)
+    type_map: Dict[str, str] = {}
+
+    # -- savepoints (the nested-transaction plane) --------------------------
+    def savepoint_sql(self, name: str) -> str:
+        return f"SAVEPOINT {name}"
+
+    def release_sql(self, name: str) -> str:
+        return f"RELEASE SAVEPOINT {name}"
+
+    def rollback_to_sql(self, name: str) -> str:
+        return f"ROLLBACK TO SAVEPOINT {name}"
+
+    # -- statements ---------------------------------------------------------
+    def translate(self, sql: str) -> str:
+        """Rewrite ``?`` placeholders into this backend's style (string
+        literals in our schema/statement set never contain ``?``, so a
+        plain replace is sufficient for the statement corpus we emit).
+
+        ``format``-paramstyle backends additionally require literal ``%``
+        doubled to ``%%`` (a future ``LIKE '%x%'`` would otherwise raise
+        in the driver); double BEFORE substituting so the injected ``%s``
+        placeholders stay intact."""
+        if self.placeholder == "?":
+            return sql
+        if self.paramstyle in ("format", "pyformat"):
+            sql = sql.replace("%", "%%")
+        return sql.replace("?", self.placeholder)
+
+    def column_type(self, generic: str) -> str:
+        return self.type_map.get(generic.upper(), generic)
+
+
+class SqliteDialect(Dialect):
+    name = "sqlite3"
+    paramstyle = "qmark"
+    placeholder = "?"
+    statement_abort_credits_total_changes = True
+    # sqlite is dynamically typed; the generic names pass through
+    type_map: Dict[str, str] = {}
+
+
+class PostgresDialect(Dialect):
+    """The postgres half of the seam: the mapping decisions, written down
+    and unit-tested, without a live server in the loop.  INSERT OR
+    REPLACE / executemany batching (storebuffer flush) would additionally
+    need ON CONFLICT rewrites — recorded here so the first live-postgres
+    PR starts from a checklist, not archaeology."""
+
+    name = "postgresql"
+    paramstyle = "format"
+    placeholder = "%s"
+    statement_abort_credits_total_changes = False
+    type_map = {
+        # our schemas' generic types -> postgres spellings
+        "BIGINT": "BIGINT",
+        "INT": "INTEGER",
+        "TEXT": "TEXT",
+        "DOUBLE PRECISION": "DOUBLE PRECISION",
+        "CHARACTER(64)": "CHARACTER(64)",
+        "VARCHAR(56)": "VARCHAR(56)",
+        "VARCHAR(32)": "VARCHAR(32)",
+        "VARCHAR(12)": "VARCHAR(12)",
+        "BLOB": "BYTEA",
+    }
+
+
+_DIALECTS = {
+    "sqlite3": SqliteDialect,
+    "postgresql": PostgresDialect,
+}
+
+
+def dialect_for(connection_string: str) -> Dialect:
+    """Dialect for a ``<scheme>://...`` connection string.  Postgres
+    strings resolve (the seam is real) even though ``Database`` itself
+    still refuses to CONNECT to them in this environment — the refusal
+    stays in Database._parse, the mapping lives here."""
+    scheme = connection_string.split("://", 1)[0]
+    cls = _DIALECTS.get(scheme)
+    if cls is None:
+        raise ValueError(
+            f"unsupported DATABASE connection string: {connection_string}"
+        )
+    return cls()

@@ -172,6 +172,21 @@ class LedgerDelta:
                 cache.erase(kb)
 
     # -- outputs -----------------------------------------------------------
+    def iter_changed(self):
+        """Yield (LedgerKey, LedgerEntry, created) for every entry this
+        delta created or modified — the invariant plane's view of the
+        close (stellar_tpu/invariant/); entries are the delta's shared
+        snapshots and must not be mutated by callers."""
+        for kb, e in self._new.items():
+            yield self._key_objs[kb], e, True
+        for kb, e in self._mod.items():
+            yield self._key_objs[kb], e, False
+
+    def iter_deleted(self):
+        """Yield the LedgerKey of every entry this delta deleted."""
+        for kb in self._delete:
+            yield self._key_objs[kb]
+
     def get_live_entries(self) -> List[LedgerEntry]:
         return list(self._new.values()) + list(self._mod.values())
 
@@ -199,26 +214,33 @@ class LedgerDelta:
     def check_against_database(self, db) -> None:
         """PARANOID_MODE audit: every live entry must match the DB row
         (LedgerDelta::checkAgainstDatabase, used at LedgerManagerImpl.cpp:705)."""
-        from .accountframe import AccountFrame
-        from .offerframe import OfferFrame
-        from .trustframe import TrustFrame
-        from ..xdr.entries import LedgerEntryType
-
-        cache = getattr(db, "_entry_cache", None)
         for kb, entry in {**self._new, **self._mod}.items():
             key = self._key_objs[kb]
-            if cache is not None:
-                cache.erase(kb)
-            if key.type == LedgerEntryType.ACCOUNT:
-                frame = AccountFrame.load_account(key.value.accountID, db)
-            elif key.type == LedgerEntryType.TRUSTLINE:
-                frame = TrustFrame.load_trust_line(
-                    key.value.accountID, key.value.asset, db
-                )
-            else:
-                frame = OfferFrame.load_offer(key.value.sellerID, key.value.offerID, db)
+            frame = load_fresh_entry(db, key)
             if frame is None or frame.entry.to_xdr() != entry.to_xdr():
                 raise RuntimeError(f"delta-vs-database mismatch for {key}")
+
+
+def load_fresh_entry(db, key):
+    """Re-read one entry straight from SQL, bypassing the decoded-entry
+    cache (the line is erased first, so the loader cannot serve a hit).
+    The single copy of the per-type loader dispatch, shared by the
+    PARANOID audit above and CacheIsConsistentWithDatabase
+    (stellar_tpu/invariant/)."""
+    from .accountframe import AccountFrame
+    from .entryframe import key_bytes
+    from .offerframe import OfferFrame
+    from .trustframe import TrustFrame
+    from ..xdr.entries import LedgerEntryType
+
+    cache = getattr(db, "_entry_cache", None)
+    if cache is not None:
+        cache.erase(key_bytes(key))
+    if key.type == LedgerEntryType.ACCOUNT:
+        return AccountFrame.load_account(key.value.accountID, db)
+    if key.type == LedgerEntryType.TRUSTLINE:
+        return TrustFrame.load_trust_line(key.value.accountID, key.value.asset, db)
+    return OfferFrame.load_offer(key.value.sellerID, key.value.offerID, db)
 
 
 def _copy_entry(e: LedgerEntry) -> LedgerEntry:

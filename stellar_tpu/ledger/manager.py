@@ -321,6 +321,16 @@ class LedgerManager:
             sv = ledger_data.value
             self.current.header.scpValue = sv
             self.current.invalidate_hash()
+            # invariant baseline: header totals (+ the all-on-mode balance
+            # sum) BEFORE fee processing or any close write — direct-apply
+            # test helpers mutate the working header and SQL rows between
+            # closes, so the last CLOSED header is the wrong zero point
+            invariants = getattr(self.app, "invariants", None)
+            inv_baseline = (
+                invariants.close_baseline(self.database, self.current.header)
+                if invariants is not None
+                else None
+            )
             ledger_delta = LedgerDelta(self.current.header, self.database)
 
             txs = ledger_data.tx_set.sort_for_apply()
@@ -419,6 +429,16 @@ class LedgerManager:
             # batched flush
             if self.app.config.PARANOID_MODE:
                 ledger_delta.check_against_database(self.database)
+
+            # ledger-invariant plane (stellar_tpu/invariant/): checks run
+            # against the flushed rows + delta + entry cache while the SQL
+            # transaction is still open, so a violation under the `raise`
+            # fail policy aborts the close (ROLLBACK + wholesale cache
+            # clear in close_ledger) instead of persisting a forked ledger
+            if invariants is not None:
+                invariants.check_close(
+                    ledger_delta, self.database, inv_baseline, txs
+                )
 
             ledger_delta.commit()
             self.current.invalidate_hash()

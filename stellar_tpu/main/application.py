@@ -70,6 +70,11 @@ class Application:
             streams=config.SIG_VERIFY_STREAMS,
             tracer=self.tracer,
         )
+        # ledger-invariant plane (stellar_tpu/invariant/): close-time
+        # safety checks driven by LedgerManager, reported via /invariants
+        from ..invariant import InvariantManager
+
+        self.invariants = InvariantManager(self)
         self.bucket_manager = BucketManager(self)
         self.ledger_manager = LedgerManager(self)
         self.history_manager = HistoryManager(self)

@@ -53,6 +53,7 @@ class CommandHandler:
             "logrotate": self.handle_logrotate,
             "profiler": self.handle_profiler,
             "trace": self.handle_trace,
+            "invariants": self.handle_invariants,
         }
 
     # -- server plumbing ----------------------------------------------------
@@ -476,6 +477,13 @@ class CommandHandler:
         out["enabled"] = tracer.enabled
         out["dropped_spans"] = dropped
         return out
+
+    def handle_invariants(self, q: dict) -> dict:
+        """Dump the ledger-invariant plane (stellar_tpu/invariant/): the
+        enabled set, fail policy, per-invariant run counts, last
+        violation, and p50/p95 cost — the operator's view of the close's
+        always-on safety checks."""
+        return self.app.invariants.dump_info()
 
     def handle_generateload(self, q: dict) -> dict:
         from ..simulation.loadgen import LoadGenerator

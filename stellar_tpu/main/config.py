@@ -193,6 +193,28 @@ class Config:
         # reference-style write-through; the differential close tests run
         # both and compare ledger hashes.
         self.ENTRY_WRITE_BUFFER = True
+        # TPU-native addition: pluggable ledger-invariant plane
+        # (stellar_tpu/invariant/) — close-time safety checks run against
+        # the ledger delta + flushed SQL + entry cache BEFORE the commit,
+        # so a violation aborts the close instead of persisting a fork.
+        # ["all"] (default) enables every registered invariant; [] turns
+        # the plane off; individual names pick a subset (see
+        # invariant/invariants.py ALL_INVARIANTS).
+        self.INVARIANT_CHECKS: List[str] = ["all"]
+        # "raise" aborts the violating close (default — the safe mode
+        # every test and PARANOID run uses); "log" records + meters the
+        # violation and lets the close commit (operator triage)
+        self.INVARIANT_FAIL_POLICY = "raise"
+        # sampled mode: exact header checks stay exact, per-entry scans
+        # cap at INVARIANT_CACHE_SAMPLE seeded-random picks, and the
+        # whole-ledger balance sums are skipped.  Sampled is the
+        # PRODUCTION default — all-on puts two full-table SUM scans plus
+        # per-changed-entry SQL re-reads on every close, which a large
+        # ledger cannot pay silently.  Tests run all-on
+        # (tx/testutils.get_test_config flips this off) and bench.py
+        # measures both modes as invariant_overhead_ms.
+        self.INVARIANT_SAMPLED = True
+        self.INVARIANT_CACHE_SAMPLE = 16
         # TPU-native addition: close-scoped frame identity map — ONE
         # AccountFrame per touched account per close, shared by fee
         # charging, validity checks, and apply instead of a defensive
@@ -266,6 +288,28 @@ class Config:
         if not (isinstance(self.TRACE_RING_SIZE, int) and self.TRACE_RING_SIZE >= 1):
             raise ValueError(
                 f"TRACE_RING_SIZE must be an int >= 1, got {self.TRACE_RING_SIZE!r}"
+            )
+        # a typo'd invariant name or fail policy must fail the boot, not
+        # silently drop a safety check (resolve also re-validates names)
+        from ..invariant import FAIL_POLICIES, resolve_invariants
+
+        if not isinstance(self.INVARIANT_CHECKS, list):
+            raise ValueError(
+                f"INVARIANT_CHECKS must be a list, got {self.INVARIANT_CHECKS!r}"
+            )
+        resolve_invariants(self.INVARIANT_CHECKS)
+        if self.INVARIANT_FAIL_POLICY not in FAIL_POLICIES:
+            raise ValueError(
+                f"INVARIANT_FAIL_POLICY must be one of {FAIL_POLICIES}, "
+                f"got {self.INVARIANT_FAIL_POLICY!r}"
+            )
+        if not (
+            isinstance(self.INVARIANT_CACHE_SAMPLE, int)
+            and self.INVARIANT_CACHE_SAMPLE >= 1
+        ):
+            raise ValueError(
+                f"INVARIANT_CACHE_SAMPLE must be an int >= 1, "
+                f"got {self.INVARIANT_CACHE_SAMPLE!r}"
             )
 
     def to_short_string(self, pk: PublicKey) -> str:

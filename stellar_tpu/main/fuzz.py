@@ -120,6 +120,13 @@ def fuzz(filename: str) -> int:
     clock = VirtualClock()
     cfg1 = get_test_config(90)
     cfg2 = get_test_config(91)
+    # invariant plane in LOG mode: under the default `raise` policy a
+    # violating close would throw out of clock.crank and kill the run
+    # mid-corpus — here the close must survive so the rest of the input
+    # keeps injecting, and the post-run oracle below turns any recorded
+    # violation into rc=1 with the full /invariants context logged
+    for cfg in (cfg1, cfg2):
+        cfg.INVARIANT_FAIL_POLICY = "log"
     app1 = Application.create(clock, cfg1, new_db=True)
     app2 = Application.create(clock, cfg2, new_db=True)
     app1.start()
@@ -157,5 +164,22 @@ def fuzz(filename: str) -> int:
         app1.graceful_stop()
         app2.graceful_stop()
         clock.shutdown()
+    # ledger-invariant oracle (stellar_tpu/invariant/): whatever the
+    # mutated message stream made the pair do, every ledger they ACCEPTED
+    # must hold the invariants — a violation here is a close-path bug the
+    # fuzzer found, not a fuzz harness failure, so the run goes red
+    violations = (
+        app1.invariants.total_violations + app2.invariants.total_violations
+    )
+    if violations:
+        for i, app in enumerate((app1, app2), 1):
+            if app.invariants.total_violations:
+                log.error("fuzz: app%d invariants: %r",
+                          i, app.invariants.dump_info())
+        log.error(
+            "fuzz: %d ledger-invariant violation(s) on accepted ledgers",
+            violations,
+        )
+        return 1
     log.info("fuzz run complete: %d messages injected", injected)
     return 0

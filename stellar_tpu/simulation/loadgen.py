@@ -124,6 +124,15 @@ class LoadGenerator:
     def is_done(self) -> bool:
         return self.pending_accounts == 0 and self.pending_txs == 0
 
+    @staticmethod
+    def invariants_clean(app) -> bool:
+        """Ledger-invariant oracle for load runs (stellar_tpu/invariant/):
+        True iff the node's invariant plane saw zero violations on the
+        ledgers this load drove.  Tests assert this after cranking a load
+        to completion; _step logs it when generation finishes."""
+        inv = getattr(app, "invariants", None)
+        return inv is None or inv.total_violations == 0
+
     # -- stepping -----------------------------------------------------------
     def _schedule(self, app) -> None:
         self.timer.expires_from_now(STEP_SECONDS)
@@ -132,6 +141,12 @@ class LoadGenerator:
     def _step(self, app) -> None:
         if self.is_done():
             self._running = False
+            if not self.invariants_clean(app):
+                log.error(
+                    "loadgen: %d ledger-invariant violation(s) fired on "
+                    "ledgers this load drove — close-path bug exposed",
+                    app.invariants.total_violations,
+                )
             log.info("load generation complete (%d accounts live)", len(self.accounts))
             return
         if self.auto_rate:

@@ -40,6 +40,13 @@ def get_test_config(instance: int = 0, backend: str = "cpu") -> Config:
     cfg.NODE_IS_VALIDATOR = True
     cfg.FORCE_SCP = True
     cfg.QUORUM_SET = SCPQuorumSet(1, [cfg.NODE_SEED.get_public_key()], [])
+    # tests run the invariant plane ALL-ON (production default is
+    # sampled): every test close pays the full conservation sums and
+    # per-entry re-reads, so an aliasing/copy-elision regression fails
+    # loudly here first (ROADMAP "Correctness" policy).  Perf harnesses
+    # that need round-comparable p50s re-pin sampled themselves
+    # (bench.py, profile_close.py).
+    cfg.INVARIANT_SAMPLED = False
     return cfg
 
 

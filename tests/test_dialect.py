@@ -1,0 +1,153 @@
+"""SQL dialect seam (database/dialect.py — ROADMAP #6).
+
+The sqlite dialect is pinned against a live Database (savepoint statement
+round-trips through the nested-transaction machinery); the postgres
+dialect's mapping decisions are unit-tested serverless, and a live
+server-gated test runs only when STELLAR_TPU_PG_DSN names a reachable
+server AND a driver is importable — nothing is installed for it.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from stellar_tpu.database.database import Database
+from stellar_tpu.database.dialect import (
+    PostgresDialect,
+    SqliteDialect,
+    dialect_for,
+)
+
+
+def test_dialect_resolution():
+    assert isinstance(dialect_for("sqlite3://:memory:"), SqliteDialect)
+    assert isinstance(dialect_for("sqlite3:///tmp/x.db"), SqliteDialect)
+    assert isinstance(dialect_for("postgresql://host/db"), PostgresDialect)
+    with pytest.raises(ValueError):
+        dialect_for("mysql://nope")
+
+
+def test_database_exposes_and_uses_dialect():
+    db = Database("sqlite3://:memory:")
+    try:
+        assert db.dialect.name == "sqlite3"
+        assert db.dialect.statement_abort_credits_total_changes
+        # savepoint statements route through the dialect: a nested
+        # rollback inside an outer commit must behave exactly as before
+        db.execute("CREATE TABLE t (v INT)")
+        with db.transaction():
+            db.execute("INSERT INTO t VALUES (1)")
+            try:
+                with db.transaction():
+                    db.execute("INSERT INTO t VALUES (2)")
+                    raise RuntimeError("inner abort")
+            except RuntimeError:
+                pass
+        assert db.query_all("SELECT v FROM t") == [(1,)]
+    finally:
+        db.close()
+
+
+def test_sqlite_dialect_statements_and_translation():
+    d = SqliteDialect()
+    assert d.savepoint_sql("sp_1") == "SAVEPOINT sp_1"
+    assert d.release_sql("sp_1") == "RELEASE SAVEPOINT sp_1"
+    assert d.rollback_to_sql("sp_1") == "ROLLBACK TO SAVEPOINT sp_1"
+    sql = "SELECT balance FROM accounts WHERE accountid=?"
+    assert d.translate(sql) == sql  # qmark passes through untouched
+    assert d.column_type("BIGINT") == "BIGINT"  # sqlite: generic as-is
+
+
+def test_postgres_dialect_mapping_decisions():
+    d = PostgresDialect()
+    assert d.placeholder == "%s" and d.paramstyle == "format"
+    assert not d.statement_abort_credits_total_changes
+    assert (
+        d.translate("UPDATE accounts SET balance=? WHERE accountid=?")
+        == "UPDATE accounts SET balance=%s WHERE accountid=%s"
+    )
+    assert d.column_type("BLOB") == "BYTEA"
+    assert d.column_type("INT") == "INTEGER"
+    assert d.savepoint_sql("sp_2") == "SAVEPOINT sp_2"
+    # format paramstyle: literal % must double to %% BEFORE placeholder
+    # substitution, so the injected %s survive intact
+    assert (
+        d.translate("SELECT accountid FROM accounts WHERE accountid LIKE '%G%' AND balance=?")
+        == "SELECT accountid FROM accounts WHERE accountid LIKE '%%G%%' AND balance=%s"
+    )
+
+
+def test_translate_hook_routes_every_query_path():
+    """The placeholder-rewrite hook (identity-skipped on sqlite) sits on
+    all four statement paths — a non-qmark backend sees every SQL
+    string."""
+    db = Database("sqlite3://:memory:")
+    try:
+        seen = []
+
+        def xl(sql):
+            seen.append(sql)
+            return sql
+
+        db._sql_translate = xl
+        db.execute("CREATE TABLE t (v INT)")
+        db.executemany("INSERT INTO t VALUES (?)", [(1,), (2,)])
+        db.query_one("SELECT v FROM t WHERE v=?", (1,))
+        db.query_all("SELECT v FROM t")
+        assert len(seen) == 4
+    finally:
+        db.close()
+
+
+def test_capability_gate_materializes_without_total_changes_credit():
+    """A backend without sqlite's statement-ABORT total_changes
+    semantics must not use the credit trick: a direct write inside a
+    savepoint-less buffered scope materializes a real savepoint
+    instead."""
+    from stellar_tpu.ledger.storebuffer import store_buffer_of
+
+    db = Database("sqlite3://:memory:")
+    try:
+        db.execute("CREATE TABLE t (v INT)")
+        buf = store_buffer_of(db)
+        db.dialect.statement_abort_credits_total_changes = False
+        with db.transaction():
+            buf.activate()
+            try:
+                with db.transaction():  # lazy (savepoint-less) scope
+                    assert db._lazy_sps and db._lazy_sps[0][0] is None
+                    db.execute("INSERT INTO t VALUES (1)")
+                    assert db._lazy_sps[0][0] is not None, (
+                        "gate must retro-open a real savepoint"
+                    )
+            finally:
+                buf.deactivate()
+        assert db.query_all("SELECT v FROM t") == [(1,)]
+    finally:
+        db.close()
+
+
+_PG_DSN = os.environ.get("STELLAR_TPU_PG_DSN")
+
+
+@pytest.mark.skipif(
+    not _PG_DSN,
+    reason="STELLAR_TPU_PG_DSN not set (no postgres server in this "
+    "environment — the dialect's live half is certified where one exists)",
+)
+def test_postgres_savepoint_syntax_live():  # pragma: no cover - server-gated
+    psycopg2 = pytest.importorskip("psycopg2")
+    d = PostgresDialect()
+    conn = psycopg2.connect(_PG_DSN)
+    try:
+        with conn.cursor() as cur:
+            cur.execute("BEGIN")
+            cur.execute(d.savepoint_sql("sp_t"))
+            cur.execute("SELECT 1")
+            cur.execute(d.rollback_to_sql("sp_t"))
+            cur.execute(d.release_sql("sp_t"))
+            cur.execute("ROLLBACK")
+    finally:
+        conn.close()

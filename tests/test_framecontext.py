@@ -76,6 +76,14 @@ class _Runner:
         assert _dump_state(fc_app.database) == _dump_state(
             ref_app.database
         ), "SQL state (entries or history metas) diverged"
+        # the ledger-invariant plane (all-on by default in test configs)
+        # audited both sides of every close above: FRAME_CONTEXT must stay
+        # invariant-clean, not merely hash-identical to context-off
+        for app in self.apps:
+            inv = app.invariants
+            assert inv.total_violations == 0, inv.dump_info()
+            assert inv.closes_checked > 0
+            assert all(s["runs"] > 0 for s in inv.stats().values())
         return results[0]
 
     def shutdown(self):

@@ -1,0 +1,329 @@
+"""Ledger-invariant plane (stellar_tpu/invariant/).
+
+Every shipped invariant gets a paired INJECTION test: the corruption
+helpers in invariant/testing.py deliberately break exactly one plane
+(SQL rows / delta snapshots / entry cache) inside a close, and the test
+proves the invariant detects it — the violation surfaces through the
+configured fail policy, /invariants, and /metrics, and under the
+``raise`` policy the close ABORTS (nothing persists, the next clean
+close succeeds).  Clean-close, loadgen-oracle, and config-validation
+coverage rides along.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+import stellar_tpu.xdr as X
+from stellar_tpu.invariant import ALL_INVARIANTS, InvariantViolation
+from stellar_tpu.invariant import testing as inj
+from stellar_tpu.main.application import Application
+from stellar_tpu.main.config import Config
+from stellar_tpu.tx import testutils as T
+from stellar_tpu.util import VIRTUAL_TIME, VirtualClock
+
+RC = X.TransactionResultCode
+
+
+@pytest.fixture
+def clock():
+    c = VirtualClock(VIRTUAL_TIME)
+    yield c
+    c.shutdown()
+
+
+def _make_app(clock, instance, checks=("all",), policy="raise",
+              sampled=False):
+    cfg = T.get_test_config(instance)
+    cfg.INVARIANT_CHECKS = list(checks)
+    cfg.INVARIANT_FAIL_POLICY = policy
+    cfg.INVARIANT_SAMPLED = sampled
+    return Application(clock, cfg, new_db=True)
+
+
+def _seq(app, sk):
+    from stellar_tpu.ledger.accountframe import AccountFrame
+
+    return AccountFrame.load_account(
+        sk.get_public_key(), app.database
+    ).get_seq_num() + 1
+
+
+def _close_payment(app, src, dst, amount=10**6):
+    lm = app.ledger_manager
+    T.close_ledger_on(
+        app, lm.last_closed.header.scpValue.closeTime + 5,
+        [T.tx_from_ops(app, src, _seq(app, src), [T.payment_op(dst, amount)])],
+    )
+
+
+def _setup_accounts(app, *names):
+    """Fund one test account per name from the root in one close."""
+    keys = [T.get_account(n) for n in names]
+    root = T.root_key_for(app)
+    lm = app.ledger_manager
+    s = _seq(app, root)
+    T.close_ledger_on(
+        app, lm.last_closed.header.scpValue.closeTime + 5,
+        [T.tx_from_ops(app, root, s,
+                       [T.create_account_op(k, 10**12) for k in keys])],
+    )
+    return keys
+
+
+class TestCleanCloses:
+    def test_all_invariants_run_and_stay_quiet(self, clock):
+        app = _make_app(clock, 82)
+        try:
+            a, b = _setup_accounts(app, "inv-a", "inv-b")
+            _close_payment(app, a, b)
+            inv = app.invariants
+            assert inv.enabled_names == list(ALL_INVARIANTS)
+            assert inv.total_violations == 0
+            assert inv.closes_checked == 2
+            for name, st in inv.stats().items():
+                assert st["runs"] == 2, name
+                assert st["violations"] == 0 and st["last_violation"] is None
+            # /metrics carries the run timers via the registry
+            mj = app.metrics.to_json()
+            for name in ALL_INVARIANTS:
+                assert mj[f"invariant.{name}.run"]["count"] == 2
+            # ...and the tracer recorded invariant.<name> spans
+            agg = app.tracer.aggregates()
+            for name in ALL_INVARIANTS:
+                assert agg[f"invariant.{name}"]["count"] == 2
+        finally:
+            app.database.close()
+
+    def test_sampled_mode_skips_full_scan_but_checks_headers(self, clock):
+        app = _make_app(clock, 83, sampled=True)
+        try:
+            a, b = _setup_accounts(app, "inv-sa", "inv-sb")
+            _close_payment(app, a, b)
+            inv = app.invariants
+            assert inv.sampled and inv.total_violations == 0
+            assert all(s["runs"] == 2 for s in inv.stats().values())
+        finally:
+            app.database.close()
+
+    def test_empty_checks_disable_the_plane(self, clock):
+        app = _make_app(clock, 84, checks=())
+        try:
+            a, b = _setup_accounts(app, "inv-xa", "inv-xb")
+            _close_payment(app, a, b)
+            assert app.invariants.closes_checked == 0
+            assert app.invariants.enabled_names == []
+        finally:
+            app.database.close()
+
+
+class TestInjectionDetection:
+    """One test per shipped invariant: corrupt its plane mid-close, prove
+    detection + abort (raise policy), prove the rollback left no damage."""
+
+    def _assert_detects(self, app, name, corruption):
+        a, b = _setup_accounts(app, f"{name}-a", f"{name}-b")
+        lm = app.ledger_manager
+        seq_before = lm.last_closed.header.ledgerSeq
+        app.invariants.inject_once(corruption)
+        with pytest.raises(InvariantViolation) as ei:
+            _close_payment(app, a, b)
+        assert ei.value.failures[0][0] == name
+        # the close ABORTED: LCL did not advance, violation recorded
+        assert lm.last_closed.header.ledgerSeq == seq_before
+        st = app.invariants.stats()[name]
+        assert st["violations"] == 1
+        assert st["last_violation"]["message"]
+        mj = app.metrics.to_json()
+        assert mj[f"invariant.{name}.violation"]["count"] == 1
+        # the SQL/cache/delta corruption died with the rollback: the same
+        # close re-runs clean (the ledger did not fork)
+        _close_payment(app, a, b)
+        assert lm.last_closed.header.ledgerSeq == seq_before + 1
+        assert app.invariants.stats()[name]["violations"] == 1
+
+    def test_conservation_detects_minted_lumens(self, clock):
+        app = _make_app(clock, 85, checks=("ConservationOfLumens",))
+        try:
+            self._assert_detects(
+                app, "ConservationOfLumens", inj.corrupt_sql_balance(12345)
+            )
+        finally:
+            app.database.close()
+
+    def test_conservation_detects_fee_mismatch(self, clock):
+        """The header half (exact even in sampled mode): leak stroops out
+        of feePool without charging a fee."""
+        app = _make_app(clock, 86, checks=("ConservationOfLumens",),
+                        sampled=True)
+        try:
+            a, b = _setup_accounts(app, "fee-a", "fee-b")
+
+            def leak_feepool(ctx):
+                ctx.delta.header.feePool += 5000
+
+            app.invariants.inject_once(leak_feepool)
+            with pytest.raises(InvariantViolation, match="feePool delta"):
+                _close_payment(app, a, b)
+        finally:
+            app.database.close()
+
+    def test_subentry_count_detects_miscount(self, clock):
+        app = _make_app(clock, 87, checks=("AccountSubEntriesCountIsValid",))
+        try:
+            self._assert_detects(
+                app, "AccountSubEntriesCountIsValid",
+                inj.corrupt_subentry_count(),
+            )
+        finally:
+            app.database.close()
+
+    def test_ledger_entry_is_valid_detects_malformed_entry(self, clock):
+        app = _make_app(clock, 88, checks=("LedgerEntryIsValid",))
+        try:
+            self._assert_detects(
+                app, "LedgerEntryIsValid", inj.malform_entry()
+            )
+        finally:
+            app.database.close()
+
+    def test_cache_db_consistency_detects_cache_desync(self, clock):
+        app = _make_app(clock, 89, checks=("CacheIsConsistentWithDatabase",))
+        try:
+            self._assert_detects(
+                app, "CacheIsConsistentWithDatabase",
+                inj.desync_cache_balance(),
+            )
+        finally:
+            app.database.close()
+
+    def test_cache_db_consistency_detects_sql_desync(self, clock):
+        """The SQL half: the row differs from the delta (a dropped or
+        corrupted flush — the store buffer's failure class)."""
+        app = _make_app(clock, 92, checks=("CacheIsConsistentWithDatabase",))
+        try:
+            self._assert_detects(
+                app, "CacheIsConsistentWithDatabase",
+                inj.corrupt_sql_balance(999),
+            )
+        finally:
+            app.database.close()
+
+
+class TestFailPolicyLog:
+    def test_log_policy_records_meters_and_commits(self, clock):
+        app = _make_app(clock, 90, checks=("ConservationOfLumens",),
+                        policy="log")
+        try:
+            a, b = _setup_accounts(app, "log-a", "log-b")
+            lm = app.ledger_manager
+            seq_before = lm.last_closed.header.ledgerSeq
+            app.invariants.inject_once(inj.corrupt_sql_balance(777))
+            _close_payment(app, a, b)  # must NOT raise
+            assert lm.last_closed.header.ledgerSeq == seq_before + 1
+            inv = app.invariants
+            assert inv.total_violations == 1
+            st = inv.stats()["ConservationOfLumens"]
+            assert st["violations"] == 1
+            assert st["last_violation"]["ledger_seq"] == seq_before + 1
+            mj = app.metrics.to_json()
+            assert mj["invariant.ConservationOfLumens.violation"]["count"] == 1
+        finally:
+            app.database.close()
+
+
+class TestAdminRoute:
+    def test_invariants_route_dumps_state(self, clock):
+        from stellar_tpu.main.commandhandler import CommandHandler
+
+        app = _make_app(clock, 91, checks=("ConservationOfLumens",),
+                        policy="log")
+        try:
+            a, b = _setup_accounts(app, "rt-a", "rt-b")
+            app.invariants.inject_once(inj.corrupt_sql_balance(31337))
+            _close_payment(app, a, b)
+            out = CommandHandler(app).execute("/invariants")
+            assert out["enabled"] == ["ConservationOfLumens"]
+            assert out["fail_policy"] == "log"
+            assert out["total_violations"] == 1
+            entry = out["invariants"]["ConservationOfLumens"]
+            assert entry["runs"] == 2 and entry["violations"] == 1
+            assert "minted" in entry["last_violation"]["message"]
+            assert entry["cost_ms"]["p50_ms"] >= 0.0
+            assert entry["cost_ms"]["p95_ms"] >= entry["cost_ms"]["p50_ms"]
+        finally:
+            app.database.close()
+
+
+class TestConfig:
+    def test_unknown_invariant_name_refused(self):
+        cfg = T.get_test_config(93)
+        cfg.INVARIANT_CHECKS = ["ConservationOfLumenz"]
+        with pytest.raises(ValueError, match="unknown invariant"):
+            cfg.validate()
+
+    def test_bad_fail_policy_refused(self):
+        cfg = T.get_test_config(93)
+        cfg.INVARIANT_FAIL_POLICY = "shrug"
+        with pytest.raises(ValueError, match="INVARIANT_FAIL_POLICY"):
+            cfg.validate()
+
+    def test_default_modes(self):
+        # production default is SAMPLED (all-on costs full-table scans
+        # per close); the test config runs all-on so regressions fail
+        # loudly in the suite first
+        assert Config().INVARIANT_SAMPLED is True
+        assert T.get_test_config(95).INVARIANT_SAMPLED is False
+
+    def test_from_dict_roundtrip(self):
+        cfg = Config.from_dict({
+            "NETWORK_PASSPHRASE": "x",
+            "INVARIANT_CHECKS": ["LedgerEntryIsValid"],
+            "INVARIANT_FAIL_POLICY": "log",
+            "INVARIANT_SAMPLED": True,
+        })
+        assert cfg.INVARIANT_CHECKS == ["LedgerEntryIsValid"]
+        assert cfg.INVARIANT_FAIL_POLICY == "log"
+        assert cfg.INVARIANT_SAMPLED is True
+
+
+def test_loadgen_full_mix_closes_are_invariant_clean(clock):
+    """The loadgen oracle (ISSUE r08): stream the full random tx mix —
+    creates, trustlines, credit payments, offers — through a node's own
+    herder with every invariant on, crank to completion, and assert no
+    invariant fired on any accepted ledger."""
+    from stellar_tpu.simulation.loadgen import LoadGenerator
+
+    cfg = T.get_test_config(94)
+    cfg.INVARIANT_CHECKS = ["all"]
+    cfg.PARANOID_MODE = True
+    app = Application.create(clock, cfg, new_db=True)
+    try:
+        app.start()
+        lg = LoadGenerator()
+        lg.generate_load(app, 6, 30, rate=100, mix="full")
+        herder = app.herder
+        lm = app.ledger_manager
+
+        def crank_and_close():
+            if lg.is_done():
+                return True
+            herder.trigger_next_ledger(lm.get_ledger_num())
+            return False
+
+        for _ in range(600):
+            if lg.is_done():
+                break
+            clock.crank(block=False)
+            crank_and_close()
+        assert lg.is_done(), "load generation stalled"
+        # drain the last trigger so in-flight txs land in a final close
+        herder.trigger_next_ledger(lm.get_ledger_num())
+        for _ in range(50):
+            clock.crank(block=False)
+        inv = app.invariants
+        assert lm.get_last_closed_ledger_num() > 1
+        assert inv.closes_checked > 0
+        assert LoadGenerator.invariants_clean(app), inv.dump_info()
+    finally:
+        app.graceful_stop()
