@@ -1018,6 +1018,16 @@ def bench_ledger_close(n_txs=5000, n_ledgers=3):
             txset.sort_for_hash()
             return txset
 
+        # copy-plane counters (ISSUE r09): xdr_copy calls and seal/CoW
+        # activity per applied tx, sampled around the timed closes only —
+        # the round-over-round trajectory of the store-snapshot elision
+        # rides every JSON line like invariant_overhead_ms
+        from stellar_tpu.ledger.entryframe import cow_stats
+        from stellar_tpu.xdr.base import xdr_copy_calls
+
+        copies0 = xdr_copy_calls()
+        cow0 = cow_stats()
+
         times = []
         for j in range(n_ledgers):
             txset = payment_txset(j)
@@ -1034,6 +1044,11 @@ def bench_ledger_close(n_txs=5000, n_ledgers=3):
             )
             times.append(time.perf_counter() - t0)
             assert ok, "payment txset must validate"
+        n_applied = max(1, n_txs * n_ledgers)
+        d_copies = xdr_copy_calls() - copies0
+        cow1 = cow_stats()
+        d_seals = cow1["seals"] - cow0["seals"]
+        d_unseals = cow1["unseals"] - cow0["unseals"]
         # per-phase p50s over the timed closes (trace/ aggregator): the
         # close-phase spans plus the signature plane underneath them
         agg = app.tracer.aggregates()
@@ -1104,6 +1119,13 @@ def bench_ledger_close(n_txs=5000, n_ledgers=3):
             "invariant_overhead_pct_of_close": round(
                 100.0 * inv_sampled_ms / close_p50_ms, 2
             ) if close_p50_ms > 0 else 0.0,
+            # copy plane (ISSUE r09): whole-process xdr_copy calls per
+            # applied tx over the timed closes, plus the seal-on-store
+            # ledger — seals that elided a store snapshot and the lazy
+            # CoW copies (unseals) actually paid back
+            "xdr_copies_per_tx": round(d_copies / n_applied, 2),
+            "cow_seals_per_tx": round(d_seals / n_applied, 2),
+            "cow_copies_per_tx": round(d_unseals / n_applied, 2),
         }
     finally:
         app.graceful_stop()

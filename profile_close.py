@@ -8,6 +8,8 @@ ledger-close p50 (BASELINE.md second headline metric).  Usage:
     python profile_close.py ladder [scale...] [--no-buffer]
     python profile_close.py ab [n_txs] [n_ledgers]       # buffer A/B
     python profile_close.py fcab [n_txs] [n_ledgers]     # frame-context A/B
+    python profile_close.py cowab [n_txs] [n_ledgers]    # CoW-snapshot A/B
+    python profile_close.py --copy-report [n_txs] [n_ledgers]  # xdr_copy sites
     python profile_close.py --assert-budget [ms] [n_txs] # regression gate
 """
 
@@ -22,7 +24,8 @@ import time
 # -- shared close-drive scaffold (used by main, ladder, and ab) -------------
 
 
-def _make_app(instance, n_txs, buffered=True, frame_context=True):
+def _make_app(instance, n_txs, buffered=True, frame_context=True, cow=True,
+              paranoid=False):
     from stellar_tpu.main.application import Application
     from stellar_tpu.tx import testutils as T
     from stellar_tpu.util.clock import VirtualClock
@@ -31,6 +34,8 @@ def _make_app(instance, n_txs, buffered=True, frame_context=True):
     cfg.DESIRED_MAX_TX_PER_LEDGER = n_txs * 2
     cfg.ENTRY_WRITE_BUFFER = buffered
     cfg.FRAME_CONTEXT = frame_context
+    cfg.COW_ENTRY_SNAPSHOTS = cow
+    cfg.PARANOID_MODE = paranoid
     # invariant plane in SAMPLED mode, matching bench.py: this harness's
     # round-over-round p50s (and the close_budget regression gate) must
     # stay comparable with pre-r08 numbers — the all-on cost is tracked
@@ -277,15 +282,15 @@ def _timed_close_run(instance, n_txs, n_ledgers, **make_app_kwargs):
         clock.shutdown()
 
 
-def _knob_ab(knob, label, n_txs, n_ledgers, instances):
+def _knob_ab(knob, label, n_txs, n_ledgers, instances, **extra):
     """On/off A/B over one _make_app kwarg: prints both close-only p50s
     and asserts the final ledger hashes match.  Pair samples within one
     window — this host's speed drifts (PROFILE.md round-5 caveat)."""
     p50_on, h_on = _timed_close_run(
-        instances[0], n_txs, n_ledgers, **{knob: True}
+        instances[0], n_txs, n_ledgers, **{knob: True}, **extra
     )
     p50_off, h_off = _timed_close_run(
-        instances[1], n_txs, n_ledgers, **{knob: False}
+        instances[1], n_txs, n_ledgers, **{knob: False}, **extra
     )
     print(
         f"{label} on:  close p50 {p50_on * 1e3:.0f} ms\n"
@@ -304,6 +309,88 @@ def ab(n_txs=5000, n_ledgers=5):
 def fcab(n_txs=5000, n_ledgers=5):
     """FRAME_CONTEXT A/B (the round-7 acceptance methodology)."""
     _knob_ab("frame_context", "FRAME_CONTEXT", n_txs, n_ledgers, (93, 94))
+
+
+def cowab(n_txs=5000, n_ledgers=5):
+    """COW_ENTRY_SNAPSHOTS A/B — PARANOID on BOTH sides (the r09
+    acceptance shape: every close's delta is audited against SQL in both
+    modes, and the final ledger hashes must match bit-exactly; the
+    SQL-dump + history-meta halves of the equivalence contract live in
+    tests/test_framecontext.py's CoW-parametrized differential suite)."""
+    _knob_ab(
+        "cow", "COW_ENTRY_SNAPSHOTS", n_txs, n_ledgers, (90, 91),
+        paranoid=True,
+    )
+
+
+def copy_report(n_txs=5000, n_ledgers=3, both=True):
+    """Per-call-site xdr_copy attribution — the PROFILE.md r6→r7
+    "105,006 → 90,009 calls" table, automated.  Runs the standard paired
+    drive under cProfile with the CoW plane on (and, with `both`, a
+    same-window CoW-off leg), then prints every call site that reaches
+    xdr_copy with its call count and calls/tx, plus the seal/CoW-copy
+    counters.  Final ledger hashes of the two legs are asserted equal."""
+    from stellar_tpu.ledger.entryframe import cow_stats
+    from stellar_tpu.xdr.base import xdr_copy_calls
+
+    def leg(instance, cow):
+        from stellar_tpu.tx import testutils as T
+
+        app, clock = _make_app(instance, n_txs, cow=cow)
+        try:
+            accounts = [T.get_account(i + 1) for i in range(n_txs + 1)]
+            created_at = _populate(app, accounts, n_txs)
+            pr = cProfile.Profile()
+            d_copies = d_seals = d_unseals = 0
+            for j in range(n_ledgers):
+                txs = _payment_txs(app, accounts, created_at, n_txs, j)
+                # sample the counters around the PROFILED close only, so
+                # the headline copies/tx covers exactly the window the
+                # per-site pstats rows attribute (tx building above also
+                # calls xdr_copy and must stay outside both)
+                copies0, cow0 = xdr_copy_calls(), cow_stats()
+                pr.enable()
+                _drive_close(app, txs)
+                pr.disable()
+                cow1 = cow_stats()
+                d_copies += xdr_copy_calls() - copies0
+                d_seals += cow1["seals"] - cow0["seals"]
+                d_unseals += cow1["unseals"] - cow0["unseals"]
+            return (
+                pr, d_copies, d_seals, d_unseals,
+                app.ledger_manager.last_closed.hash,
+            )
+        finally:
+            app.graceful_stop()
+            clock.shutdown()
+
+    def report(tag, pr, d_copies, d_seals, d_unseals):
+        n_applied = n_txs * n_ledgers
+        print(
+            f"\n== {tag}: xdr_copy {d_copies} calls over {n_ledgers} closes"
+            f" of {n_txs} txs = {d_copies / n_applied:.2f}/tx"
+            f"  (seals {d_seals / n_applied:.2f}/tx,"
+            f" CoW copies paid {d_unseals / n_applied:.2f}/tx) =="
+        )
+        stats = pstats.Stats(pr).stats
+        rows = []
+        for func, (_cc, _nc, _tt, _ct, callers) in stats.items():
+            if func[2] != "xdr_copy":
+                continue
+            for site, (_scc, snc, _stt, _sct) in callers.items():
+                rows.append((snc, f"{site[0].split('/')[-1]}:{site[1]}"
+                                  f" {site[2]}"))
+        rows.sort(reverse=True)
+        for calls, site in rows:
+            print(f"  {calls:>9,}  {calls / n_applied:6.2f}/tx  {site}")
+
+    on = leg(88, True)
+    report("CoW ON", *on[:4])
+    if both:
+        off = leg(89, False)
+        report("CoW OFF", *off[:4])
+        assert on[4] == off[4], "ledger hash diverged between CoW modes!"
+        print("\nfinal ledger hashes match")
 
 
 def assert_budget(budget_ms=2000.0, n_txs=5000, n_ledgers=3):
@@ -343,6 +430,18 @@ if __name__ == "__main__":
         fcab(
             int(args[1]) if len(args) > 1 else 5000,
             int(args[2]) if len(args) > 2 else 5,
+        )
+    elif args and args[0] == "cowab":
+        cowab(
+            int(args[1]) if len(args) > 1 else 5000,
+            int(args[2]) if len(args) > 2 else 5,
+        )
+    elif args and args[0] == "--copy-report":
+        rest = [a for a in args[1:] if a != "--single"]
+        copy_report(
+            int(rest[0]) if rest else 5000,
+            int(rest[1]) if len(rest) > 1 else 3,
+            both="--single" not in args,
         )
     elif args and args[0] == "--assert-budget":
         sys.exit(

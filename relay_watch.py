@@ -87,6 +87,14 @@ FINAL_STEPS = [
       "import json, bench; r = bench.bench_ledger_close(n_txs=500, "
       "n_ledgers=5); print(json.dumps(r))"],
      900),
+    # r09: certify the seal-on-store copy plane in a quiet green window —
+    # paired same-window CoW on/off cProfile with per-call-site xdr_copy
+    # attribution + final-hash equality (the ISSUE r09 acceptance drive;
+    # bench.py's xdr_copies_per_tx carries the round-over-round
+    # trajectory on every close line)
+    ("cow_close_r09",
+     [sys.executable, "-u", "profile_close.py", "--copy-report", "5000", "3"],
+     2400),
 ]
 ALL_NAMES = (
     [s[0] for s in SCRIPT_STEPS]

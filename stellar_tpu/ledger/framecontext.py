@@ -45,6 +45,12 @@ by three rules:
   frame retained past its close cannot silently write stale state into a
   later ledger.
 
+Seal-on-store CoW (round 9) composes with the map: a store seals the
+context frame (its entry becomes the shared delta/cache/buffer snapshot,
+EntryFrame.touch), and ``lend`` un-seals on the next MUTABLE hand-out —
+the one copy the old eager scheme paid per store is paid at most once
+per re-borrow, and accounts whose last touch is a store never pay it.
+
 The map is account-only (the profile's hot class; trust/offer loads are
 comparatively rare) and lives on the ``Database`` object next to the entry
 cache and store buffer, activated by ``LedgerManager.close_ledger``.
@@ -105,14 +111,24 @@ class FrameContext:
     def lend(self, kb: bytes, mutable: bool):
         """The context frame for `kb`, or None.  Mutable hand-outs inside a
         savepoint are logged so a rollback evicts them (the borrower may
-        mutate the frame before the scope dies)."""
+        mutate the frame before the scope dies).
+
+        A SEALED frame (its entry is the shared post-store snapshot in
+        the delta/cache/store-buffer — see EntryFrame.touch) is CoW-
+        unsealed before a mutable hand-out: borrowers mutate through raw
+        entry fields (``f.account.balance -= fee``), so handing a sealed
+        frame out mutable would let those writes reach the shared
+        snapshot and silently rewrite recorded history metas."""
         f = self._map.get(kb)
         if f is None:
             self.misses += 1
             return None
         self.hits += 1
-        if mutable and self._marks:
-            self._note(kb)
+        if mutable:
+            if getattr(f, "_sealed", False):
+                f.touch()
+            if self._marks:
+                self._note(kb)
         return f
 
     def adopt(self, kb: bytes, frame) -> None:

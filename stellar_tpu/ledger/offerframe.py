@@ -59,6 +59,9 @@ class OfferFrame(EntryFrame):
             LedgerKeyOffer(self.offer.sellerID, self.offer.offerID),
         )
 
+    def _rebind_entry(self) -> None:
+        self.offer = self.entry.data.value
+
     def get_price(self) -> Price:
         return self.offer.price
 

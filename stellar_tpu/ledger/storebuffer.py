@@ -80,7 +80,15 @@ class EntryStoreBuffer:
     # -- store side (EntryFrame) -------------------------------------------
     def record(self, kb: bytes, key: LedgerKey, entry: Optional[LedgerEntry],
                cls: type) -> None:
-        """Pending upsert (entry) or delete (entry=None) of `key`."""
+        """Pending upsert (entry) or delete (entry=None) of `key`.
+
+        `entry` is the ONE shared immutable snapshot of the store
+        (EntryFrame._record) — under seal-on-store it is the storing
+        frame's live sealed entry, so this buffer (like the delta and the
+        cache) must only read it: flush packs it to SQL rows, get() hands
+        it out under the copy-before-mutate contract below, and the undo
+        log restores previous snapshot objects verbatim on rollback —
+        eviction/restoration of slots, never mutation of entries."""
         if self._marks:
             self._undo.append((kb, self._overlay.get(kb, _ABSENT)))
         self._overlay[kb] = (key, entry, cls)

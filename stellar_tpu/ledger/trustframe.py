@@ -90,6 +90,9 @@ class TrustFrame(EntryFrame):
             LedgerKeyTrustLine(self.trust_line.accountID, self.trust_line.asset),
         )
 
+    def _rebind_entry(self) -> None:
+        self.trust_line = self.entry.data.value
+
     # -- accessors ---------------------------------------------------------
     def get_balance(self) -> int:
         return self.trust_line.balance
@@ -107,7 +110,7 @@ class TrustFrame(EntryFrame):
             return False
         if self.trust_line.balance + delta < 0:
             return False
-        self.trust_line.balance += delta
+        self.mut().balance += delta
         return True
 
     def get_max_amount_receive(self) -> int:
@@ -122,9 +125,9 @@ class TrustFrame(EntryFrame):
 
     def set_authorized(self, authorized: bool) -> None:
         if authorized:
-            self.trust_line.flags |= TrustLineFlags.AUTHORIZED_FLAG
+            self.mut().flags |= TrustLineFlags.AUTHORIZED_FLAG
         else:
-            self.trust_line.flags &= ~TrustLineFlags.AUTHORIZED_FLAG
+            self.mut().flags &= ~TrustLineFlags.AUTHORIZED_FLAG
 
     # -- SQL ---------------------------------------------------------------
     @staticmethod

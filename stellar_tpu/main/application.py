@@ -59,6 +59,11 @@ class Application:
             metrics=self.metrics,
         )
         self.database = Database(config.DATABASE, self.metrics)
+        # seal-on-store CoW entry snapshots (ledger/entryframe.py): the
+        # knob rides the Database object because EntryFrame._record has
+        # db, not config, in hand (same pattern as the entry cache /
+        # store buffer / frame context planes)
+        self.database._cow_entry_snapshots = config.COW_ENTRY_SNAPSHOTS
         self.persistent_state = PersistentState(self.database)
         self.tmp_dirs = TmpDirManager(config.TMP_DIR_PATH)
         # the SIGNATURE_BACKEND knob: every batch verify in the node flows

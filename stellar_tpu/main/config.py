@@ -223,6 +223,15 @@ class Config:
         # (tests/test_framecontext.py) runs both and compares ledger
         # hashes + SQL dumps + history metas.
         self.FRAME_CONTEXT = True
+        # TPU-native addition: seal-on-store copy-on-write entry
+        # snapshots — EntryFrame._record shares the frame's live entry
+        # with the delta / entry cache / store buffer instead of deep-
+        # copying per store; the frame pays the copy lazily at its next
+        # mutating access (EntryFrame.touch), so entries stored once per
+        # close never copy.  Off = eager per-store snapshots; the
+        # differential suite (tests/test_framecontext.py) runs both and
+        # compares ledger hashes + SQL dumps + history metas.
+        self.COW_ENTRY_SNAPSHOTS = True
 
     # -- loading -----------------------------------------------------------
     @classmethod

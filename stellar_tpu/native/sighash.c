@@ -441,8 +441,9 @@ item_row(const Item *it, uint8_t row[128], const uint8_t *bl, int nbl)
 }
 
 static void
-run_job_tiles(Job *j)
+run_job_tiles(void *arg)
 {
+    Job *j = arg;
     uint8_t rows[TILE][128];
     size_t ntiles = (j->n + TILE - 1) / TILE;
     size_t rej = 0, t;
@@ -478,14 +479,18 @@ run_job_tiles(Job *j)
 static pthread_mutex_t pool_mu = PTHREAD_MUTEX_INITIALIZER;
 static pthread_cond_t pool_go = PTHREAD_COND_INITIALIZER;
 static pthread_cond_t pool_done = PTHREAD_COND_INITIALIZER;
-/* one fanned-out job at a time: a second concurrent stage() caller (two
- * stager threads) must not clobber pool_job/pool_active — it runs its
- * own job inline instead (see the trylock in sighash_stage) */
+/* one fanned-out job at a time: a second concurrent caller (two stager
+ * threads, or a stage() racing a sodium_verify()) must not clobber
+ * pool_fn/pool_arg/pool_active — it runs its own job inline instead
+ * (see the trylock at each call site).  The job is a generic
+ * (function, argument) pair so the same pool serves the staging tiles
+ * AND the libsodium strict-verify tiles. */
 static pthread_mutex_t pool_busy = PTHREAD_MUTEX_INITIALIZER;
 static int pool_workers = 0;
 static unsigned long pool_gen = 0;
 static int pool_active = 0;
-static Job *pool_job = NULL;
+static void (*pool_fn)(void *) = NULL;
+static void *pool_arg = NULL;
 
 static void *
 worker_main(void *arg)
@@ -497,9 +502,10 @@ worker_main(void *arg)
         while (pool_gen == seen)
             pthread_cond_wait(&pool_go, &pool_mu);
         seen = pool_gen;
-        Job *j = pool_job;
+        void (*fn)(void *) = pool_fn;
+        void *a = pool_arg;
         pthread_mutex_unlock(&pool_mu);
-        run_job_tiles(j);
+        fn(a);
         pthread_mutex_lock(&pool_mu);
         if (--pool_active == 0)
             pthread_cond_signal(&pool_done);
@@ -534,22 +540,73 @@ ensure_workers(int want)
 }
 
 static void
-run_parallel(Job *j)
+run_parallel(void (*fn)(void *), void *arg)
 {
     pthread_mutex_lock(&pool_mu);
     ensure_workers(hw_threads() - 1 < MAX_WORKERS ? hw_threads() - 1
                                                   : MAX_WORKERS);
-    pool_job = j;
+    pool_fn = fn;
+    pool_arg = arg;
     pool_active = pool_workers;
     pool_gen++;
     pthread_cond_broadcast(&pool_go);
     pthread_mutex_unlock(&pool_mu);
-    run_job_tiles(j); /* the calling thread works too */
+    fn(arg); /* the calling thread works too */
     pthread_mutex_lock(&pool_mu);
     while (pool_active)
         pthread_cond_wait(&pool_done, &pool_mu);
-    pool_job = NULL;
+    pool_fn = NULL;
+    pool_arg = NULL;
     pthread_mutex_unlock(&pool_mu);
+}
+
+/* -- libsodium strict-verify tiles (the pure-CPU fallback leg) ------- */
+/* The caller (crypto/sigbackend._sodium_verify_native) hands us the
+ * ADDRESS of crypto_sign_verify_detached out of the already-loaded
+ * libsodium; the tiles call it directly with the GIL released, so the
+ * whole cache-miss batch fans over the worker pool with zero per-item
+ * Python dispatch.  Length prechecks mirror sodium.verify_detached
+ * (len(sig)!=64 or len(pk)!=32 -> False) so results are byte-identical
+ * to the serial loop. */
+
+typedef int (*sodium_verify_fn)(const unsigned char *sig,
+                                const unsigned char *msg,
+                                unsigned long long msg_len,
+                                const unsigned char *pk);
+
+typedef struct {
+    const Item *items;
+    size_t n;
+    uint8_t *ok;       /* n bytes of 0/1 verdicts */
+    sodium_verify_fn fn;
+    size_t next_tile;  /* atomic work counter */
+} VJob;
+
+/* a libsodium verify is ~50 us — small tiles keep the tail balanced,
+ * and fanout pays off at far smaller batches than the hashing stage */
+#define VTILE 32
+#define VPAR_MIN 64
+
+static void
+run_verify_tiles(void *arg)
+{
+    VJob *j = arg;
+    size_t ntiles = (j->n + VTILE - 1) / VTILE, t;
+    while ((t = __atomic_fetch_add(&j->next_tile, 1, __ATOMIC_RELAXED)) <
+           ntiles) {
+        size_t lo = t * VTILE;
+        size_t hi = lo + VTILE;
+        size_t i;
+        if (hi > j->n)
+            hi = j->n;
+        for (i = lo; i < hi; i++) {
+            const Item *it = &j->items[i];
+            j->ok[i] = (uint8_t)(it->pk_len == 32 && it->sig_len == 64 &&
+                                 j->fn(it->sig, it->msg,
+                                       (unsigned long long)it->msg_len,
+                                       it->pk) == 0);
+        }
+    }
 }
 
 /* ------------------------------------------------------------------ */
@@ -664,7 +721,7 @@ sighash_stage(PyObject *self, PyObject *args)
         if (threads == 1 || count < PAR_MIN || hw_threads() < 2) {
             run_job_tiles(&job);
         } else if (pthread_mutex_trylock(&pool_busy) == 0) {
-            run_parallel(&job);
+            run_parallel(run_job_tiles, &job);
             pthread_mutex_unlock(&pool_busy);
         } else {
             /* the pool is mid-job for another caller: run inline */
@@ -706,6 +763,111 @@ fail:
         PyBuffer_Release(&okb);
     if (bl.obj)
         PyBuffer_Release(&bl);
+    return NULL;
+}
+
+/* sodium_verify(fn_addr, items, ok, threads=0) -> None
+ *
+ * fn_addr   address of libsodium's crypto_sign_verify_detached (the
+ *           caller resolves it via ctypes from the SAME library object
+ *           the serial path calls — one verifier, two drivers)
+ * items     sequence of (pk, msg, sig) bytes tuples (the LAST three
+ *           slots are used, like stage())
+ * ok        writable uint8 buffer, >= len(items): per-item verdicts
+ * threads   0 = auto (pool when n >= 64 and >1 core), 1 = inline
+ */
+static PyObject *
+sighash_sodium_verify(PyObject *self, PyObject *args)
+{
+    PyObject *seq, *fast = NULL;
+    unsigned long long fn_addr = 0;
+    Py_buffer okb = {0};
+    int threads = 0;
+    Item *items = NULL;
+    Py_ssize_t n = 0, j;
+    (void)self;
+
+    if (!PyArg_ParseTuple(args, "KOw*|i", &fn_addr, &seq, &okb, &threads))
+        return NULL;
+    if (fn_addr == 0) {
+        PyErr_SetString(PyExc_ValueError, "null verify function pointer");
+        goto fail;
+    }
+    fast = PySequence_Fast(seq,
+                           "sodium_verify needs a sequence of tuples");
+    if (fast == NULL)
+        goto fail;
+    n = PySequence_Fast_GET_SIZE(fast);
+    if (okb.len < n) {
+        PyErr_SetString(PyExc_ValueError, "ok buffer too small");
+        goto fail;
+    }
+    items = PyMem_Malloc((n ? n : 1) * sizeof(Item));
+    if (items == NULL) {
+        PyErr_NoMemory();
+        goto fail;
+    }
+    memset(items, 0, (n ? n : 1) * sizeof(Item));
+    for (j = 0; j < n; j++) {
+        PyObject *t = PySequence_Fast_GET_ITEM(fast, j);
+        Py_ssize_t sz;
+        if (!PyTuple_Check(t) || (sz = PyTuple_GET_SIZE(t)) < 3) {
+            PyErr_SetString(PyExc_TypeError,
+                            "items must be tuples of >= 3 slots "
+                            "(..., pk, msg, sig)");
+            goto fail;
+        }
+        items[j].pk_o = borrow_bytes(PyTuple_GET_ITEM(t, sz - 3),
+                                     &items[j].pk, &items[j].pk_len);
+        items[j].msg_o = borrow_bytes(PyTuple_GET_ITEM(t, sz - 2),
+                                      &items[j].msg, &items[j].msg_len);
+        items[j].sig_o = borrow_bytes(PyTuple_GET_ITEM(t, sz - 1),
+                                      &items[j].sig, &items[j].sig_len);
+        if (!items[j].pk_o || !items[j].msg_o || !items[j].sig_o)
+            goto fail;
+    }
+
+    {
+        VJob job;
+        job.items = items;
+        job.n = (size_t)n;
+        job.ok = (uint8_t *)okb.buf;
+        job.fn = (sodium_verify_fn)(uintptr_t)fn_addr;
+        job.next_tile = 0;
+        Py_BEGIN_ALLOW_THREADS
+        if (threads == 1 || n < VPAR_MIN || hw_threads() < 2) {
+            run_verify_tiles(&job);
+        } else if (pthread_mutex_trylock(&pool_busy) == 0) {
+            run_parallel(run_verify_tiles, &job);
+            pthread_mutex_unlock(&pool_busy);
+        } else {
+            /* the pool is mid-job for another caller: run inline */
+            run_verify_tiles(&job);
+        }
+        Py_END_ALLOW_THREADS
+    }
+
+    for (j = 0; j < n; j++) {
+        Py_DECREF(items[j].pk_o);
+        Py_DECREF(items[j].msg_o);
+        Py_DECREF(items[j].sig_o);
+    }
+    PyMem_Free(items);
+    Py_DECREF(fast);
+    PyBuffer_Release(&okb);
+    Py_RETURN_NONE;
+
+fail:
+    if (items != NULL)
+        for (j = 0; j < n; j++) {
+            Py_XDECREF(items[j].pk_o);
+            Py_XDECREF(items[j].msg_o);
+            Py_XDECREF(items[j].sig_o);
+        }
+    PyMem_Free(items);
+    Py_XDECREF(fast);
+    if (okb.obj)
+        PyBuffer_Release(&okb);
     return NULL;
 }
 
@@ -760,6 +922,10 @@ static PyMethodDef methods[] = {
     {"stage", sighash_stage, METH_VARARGS,
      "stage(items, start, count, out, ok, blacklist, threads=0) -> "
      "rejects: gate + SHA-512(R||A||M) mod L + transposed staging"},
+    {"sodium_verify", sighash_sodium_verify, METH_VARARGS,
+     "sodium_verify(fn_addr, items, ok, threads=0): batch libsodium"
+     " strict verify over the worker pool, GIL released; verdicts land"
+     " in the ok buffer"},
     {"_sha512_rax", sighash_sha512_rax, METH_VARARGS,
      "_sha512_rax(r32, a32, msg) -> sha512(r||a||msg) digest (test hook)"},
     {"_reduce512", sighash_reduce512, METH_VARARGS,

@@ -261,6 +261,13 @@ class TransactionFrame:
         so op mutations are visible through the tx frame and vice versa."""
         sa = self.signing_account
         if sa is not None and sa.account.accountID == account_id:
+            if sa._sealed:
+                # an earlier op (or fee charging) stored — and thereby
+                # sealed — the shared signing frame; this op may mutate it
+                # through raw entry fields, so CoW-unseal on hand-out
+                # exactly like FrameContext.lend does (the recorded
+                # snapshots in the delta/cache/buffer stay immutable)
+                sa.touch()
             return sa
         return AccountFrame.load_account(account_id, db)
 
@@ -346,7 +353,7 @@ class TransactionFrame:
             if avail < fee:
                 fee = avail  # take all they have
                 self.result.feeCharged = fee
-            self.signing_account.account.balance -= fee
+            self.signing_account.mut().balance -= fee
             delta.get_header().feePool += fee
         if self.signing_account.get_seq_num() + 1 != self.envelope.tx.seqNum:
             raise RuntimeError("Unexpected account state: bad sequence")

@@ -49,6 +49,9 @@ class OfferExchange:
         max_sheep_send: int,
     ):
         """-> (CrossOfferResult, num_wheat_received, num_sheep_send)."""
+        # load_best_offers frames are always freshly decoded/copied (never
+        # sealed), so this binding may be mutated in place until the
+        # store below seals it; nothing touches `offer` after that store
         offer = selling_wheat_offer.offer
         sheep = offer.buying
         wheat = offer.selling
@@ -133,7 +136,10 @@ class OfferExchange:
 
         if num_sheep_send != 0:
             if sheep.is_native():
-                account_b.account.balance += num_sheep_send
+                # mut(): the offer-taken branch above may already have
+                # stored (and thereby sealed) account_b — the credit must
+                # CoW, not reach the recorded numSubEntries snapshot
+                account_b.mut().balance += num_sheep_send
                 account_b.store_change(self.delta, db)
             else:
                 if not sheep_line_b.add_balance(num_sheep_send):
@@ -142,7 +148,7 @@ class OfferExchange:
 
         if num_wheat_received != 0:
             if wheat.is_native():
-                account_b.account.balance -= num_wheat_received
+                account_b.mut().balance -= num_wheat_received
                 account_b.store_change(self.delta, db)
             else:
                 if not wheat_line_b.add_balance(-num_wheat_received):

@@ -103,7 +103,7 @@ class CreateAccountOpFrame(OperationFrame):
                 CreateAccountResult(CreateAccountResultCode.CREATE_ACCOUNT_UNDERFUNDED)
             )
             return False
-        self.source_account.account.balance -= self.ca.startingBalance
+        self.source_account.mut().balance -= self.ca.startingBalance
         self.source_account.store_change(delta, db)
         dest = AccountFrame(account_id=self.ca.destination)
         # new accounts start at (currentLedgerSeq << 32)
@@ -176,7 +176,10 @@ class SetOptionsOpFrame(OperationFrame):
     def do_apply(self, metrics, delta, lm) -> bool:
         so = self.so
         db = lm.database
-        account = self.source_account.account
+        # mut(): the shared signing frame may be sealed (fee charging or
+        # an earlier op stored it); every branch below mutates `account`
+        # in place, so bind the CoW-unsealed entry once up front
+        account = self.source_account.mut()
 
         def fail(tag, code):
             metrics.new_meter(("op-set-options", "failure", tag), "operation").mark()
@@ -306,7 +309,7 @@ class ChangeTrustOpFrame(OperationFrame):
             else:
                 if issuer is None:
                     return fail("no-issuer", ChangeTrustResultCode.CHANGE_TRUST_NO_ISSUER)
-                line.trust_line.limit = ct.limit
+                line.mut().limit = ct.limit
                 line.store_change(delta, db)
             return succeed()
         else:
@@ -424,7 +427,7 @@ class MergeOpFrame(OperationFrame):
                 "has-sub-entries", AccountMergeResultCode.ACCOUNT_MERGE_HAS_SUB_ENTRIES
             )
         balance = acc.balance
-        other.account.balance += balance
+        other.mut().balance += balance
         other.store_change(delta, db)
         self.source_account.store_delete(delta, db)
         metrics.new_meter(("op-merge", "success", "apply"), "operation").mark()
@@ -481,7 +484,7 @@ class InflationOpFrame(OperationFrame):
             if winner is not None:
                 left -= to_dole
                 header.totalCoins += to_dole
-                winner.account.balance += to_dole
+                winner.mut().balance += to_dole
                 winner.store_change(inflation_delta, db)
                 payouts.append(InflationPayout(dest, to_dole))
         header.feePool += left

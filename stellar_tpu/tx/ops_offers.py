@@ -174,7 +174,7 @@ class ManageOfferOpFrame(OperationFrame):
             with db.transaction():
                 temp_delta = LedgerDelta(outer=delta)
                 if mo.amount == 0:
-                    sell_offer.offer.amount = 0
+                    sell_offer.mut().amount = 0
                 else:
                     if sheep.is_native():
                         max_sheep_can_sell = (
@@ -231,21 +231,24 @@ class ManageOfferOpFrame(OperationFrame):
 
                     if wheat_received > 0:
                         if wheat.is_native():
-                            self.source_account.account.balance += wheat_received
+                            self.source_account.mut().balance += wheat_received
                             self.source_account.store_change(delta, db)
                         else:
                             if not self.wheat_line.add_balance(wheat_received):
                                 raise RuntimeError("offer claimed over limit")
                             self.wheat_line.store_change(delta, db)
                         if sheep.is_native():
-                            self.source_account.account.balance -= sheep_sent
+                            # the store above SEALED the frame: mut() pays
+                            # the CoW copy so the debit cannot reach the
+                            # wheat-credit snapshot already recorded
+                            self.source_account.mut().balance -= sheep_sent
                             self.source_account.store_change(delta, db)
                         else:
                             if not self.sheep_line.add_balance(-sheep_sent):
                                 raise RuntimeError("offer sold more than balance")
                             self.sheep_line.store_change(delta, db)
 
-                    sell_offer.offer.amount = max_sheep_send - sheep_sent
+                    sell_offer.mut().amount = max_sheep_send - sheep_sent
 
                 if sell_offer.offer.amount > 0:
                     if creating_new:
@@ -255,7 +258,7 @@ class ManageOfferOpFrame(OperationFrame):
                                 ManageOfferResultCode.MANAGE_OFFER_LOW_RESERVE,
                             )
                             raise _OfferAbort()
-                        sell_offer.offer.offerID = temp_delta.generate_id()
+                        sell_offer.mut().offerID = temp_delta.generate_id()
                         success.offer = ManageOfferSuccessResultOffer(
                             ManageOfferEffect.MANAGE_OFFER_CREATED, None
                         )

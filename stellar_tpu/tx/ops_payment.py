@@ -172,7 +172,7 @@ class PathPaymentOpFrame(OperationFrame):
 
         # credit the last hop
         if cur_b.is_native():
-            destination.account.balance += cur_b_received
+            destination.mut().balance += cur_b_received
             destination.store_change(delta, db)
         else:
             if bypass_issuer_check:
@@ -272,7 +272,7 @@ class PathPaymentOpFrame(OperationFrame):
                     "underfunded",
                     PathPaymentResultCode.PATH_PAYMENT_UNDERFUNDED,
                 )
-            self.source_account.account.balance -= cur_b_sent
+            self.source_account.mut().balance -= cur_b_sent
             self.source_account.store_change(delta, db)
         else:
             if bypass_issuer_check:

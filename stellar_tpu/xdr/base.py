@@ -39,8 +39,10 @@ __all__ = [
     "xf",
     "codec_of",
     "pack",
+    "pack_many",
     "unpack",
     "xdr_copy",
+    "xdr_copy_calls",
     "xdr_to_opaque",
     "xdr_getfield",
     "xdr_setfield",
@@ -747,6 +749,44 @@ def pack(val: Any, codec: Optional[XdrCodec] = None) -> bytes:
     return (codec or codec_of(val)).pack(val)
 
 
+def pack_many(values, cls_or_codec, frames: bool = False) -> bytes:
+    """Concatenated XDR encoding of ``values`` (all one codec) in ONE C
+    call when the extension compiled — the batch plane for hot sites that
+    serialize whole lists per ledger close (bucket add_batch packs the
+    close's live/dead entries through this).  ``frames=True`` prefixes
+    every record with the RFC 5531 record mark (length | 0x80000000), the
+    XDROutputFileStream framing, so a bucket batch becomes one buffer to
+    hash and one write.
+
+    Same octet stream and XdrError failure contract as per-value
+    ``pack``: a malformed element raises and nothing is returned (the
+    partially-built buffer is discarded — pinned by the hostile cases in
+    tests/test_cxdrpack.py).  Hosts without the extension (or with a
+    codec the C side does not model) run the equivalent Python loop."""
+    codec = (
+        cls_or_codec
+        if isinstance(cls_or_codec, XdrCodec)
+        else codec_of(cls_or_codec)
+    )
+    vals = values if isinstance(values, (list, tuple)) else list(values)
+    prog = codec._cprog
+    if prog is None:
+        prog = codec._compile_cprog()
+    if prog is not False:
+        fn = getattr(_cxdr(), "pack_many", None)  # tolerate a stale .so
+        if fn is not None:
+            return fn(prog, vals, 1 if frames else 0)
+    out = bytearray()
+    for v in vals:
+        body = codec.pack(v)
+        if frames:
+            if len(body) >= 0x80000000:
+                raise XdrError("record too large")
+            out += _U32.pack(len(body) | 0x80000000)
+        out += body
+    return bytes(out)
+
+
 def unpack(cls, data: bytes) -> Any:
     return codec_of(cls).unpack(data)
 
@@ -799,6 +839,20 @@ def unpack_var_arrays(data: bytes, classes) -> Tuple[list, ...]:
     return tuple(out)
 
 
+# process-wide xdr_copy call counter: the copy plane is the ledger close's
+# dominant remaining host cost (PROFILE.md r7/r8), so bench.py surfaces
+# copies-per-tx on every close line and profile_close.py --copy-report
+# attributes them per call site.  A bare int += keeps the hot path cost
+# to nanoseconds; readers only ever difference two samples.
+_N_COPIES = 0
+
+
+def xdr_copy_calls() -> int:
+    """Total xdr_copy invocations in this process (monotonic; sample
+    before/after a workload and difference)."""
+    return _N_COPIES
+
+
 def xdr_copy(obj):
     """Codec-driven structural deep copy of any xstruct/xunion value —
     equivalent to ``from_xdr(to_xdr(obj))`` without the serialization.
@@ -806,6 +860,8 @@ def xdr_copy(obj):
     semantics: immutable subtrees shared, containers rebuilt) when the
     codec compiled; the ledger apply path copies entries/headers per
     nested delta, so this is hot at close."""
+    global _N_COPIES
+    _N_COPIES += 1
     codec = obj._codec
     prog = codec._cprog
     if prog is None:

@@ -477,3 +477,45 @@ def test_single_entry_bubbling_up(app):
                 assert curr_sz + snap_sz == 1, (i, j)
             else:
                 assert curr_sz == 0 and snap_sz == 0, (i, j)
+
+
+def test_fresh_pack_many_matches_streaming_writer(app):
+    """Bucket.fresh's batched pack_many path (one buffer, one hash, one
+    write) must produce bit-identical bucket files to the streaming
+    _write_merged path it replaced — same hash, same record stream —
+    including live/dead identity collisions (dead wins) and duplicate
+    identities inside one input list (last wins)."""
+    from stellar_tpu.bucket.bucket import _write_merged
+
+    bm = app.bucket_manager
+    live = [account_entry(i, balance=100 + i) for i in (5, 1, 9, 3, 7)]
+    live.append(account_entry(9, balance=999))  # duplicate identity: last wins
+    dead = [ledger_key_of(account_entry(3)), ledger_key_of(account_entry(2))]
+
+    batched = Bucket.fresh(bm, live, dead)
+
+    live_be = [BucketEntry(BucketEntryType.LIVEENTRY, e) for e in live]
+    dead_be = [BucketEntry(BucketEntryType.DEADENTRY, k) for k in dead]
+    live_be.sort(key=entry_identity)
+    dead_be.sort(key=entry_identity)
+    streamed = _write_merged(
+        bm, iter(live_be), iter(dead_be), [], keep_dead_entries=True
+    )
+
+    assert batched.get_hash() == streamed.get_hash()
+    with open(batched.path, "rb") as f1, open(streamed.path, "rb") as f2:
+        assert f1.read() == f2.read()
+    # dead wins the id-3 collision; the id-9 duplicate collapsed last-wins
+    recs = {
+        entry_identity(be): be for be in batched
+    }
+    assert recs[entry_identity(dead_be[-1])].type == BucketEntryType.DEADENTRY
+    nine = recs[entry_identity(BucketEntry(BucketEntryType.LIVEENTRY,
+                                           account_entry(9)))]
+    assert nine.value.data.value.balance == 999
+
+
+def test_fresh_empty_batch_is_empty_bucket(app):
+    b = Bucket.fresh(app.bucket_manager, [], [])
+    assert b.get_hash() == ZERO_HASH
+    assert list(b) == []

@@ -546,3 +546,126 @@ class TestFieldAccessHostilePaths:
         diff = [i for i, (x, y) in enumerate(zip(data, out)) if x != y]
         assert diff and max(diff) - min(diff) < 4, "patch must stay in-field"
         assert codec.unpack(out).lastModifiedLedgerSeq == 0x0A0B0C0D
+
+
+# -- pack_many batch encoder (round 9, bucket add_batch plane) --------------
+
+
+class TestPackMany:
+    """pack_many(values, cls, frames=) must emit exactly the octets of the
+    per-value pack loop (optionally with RFC 5531 record marks — the
+    XDROutputFileStream framing the bucket files use), share pack's
+    XdrError failure contract on a malformed element, and stay available
+    through the Python fallback on extension-less hosts."""
+
+    def _entries(self, n=40, seed=909):
+        from stellar_tpu.xdr.entries import LedgerEntry
+
+        rng = random.Random(seed)
+        codec = codec_of(LedgerEntry)
+        return codec, [
+            arbitrary.arbitrary(codec, size=6, rng=rng) for _ in range(n)
+        ]
+
+    def test_differential_vs_per_entry_to_xdr(self):
+        from stellar_tpu.xdr.base import pack_many
+
+        codec, vals = self._entries()
+        assert pack_many(vals, codec) == b"".join(
+            v.to_xdr() for v in vals
+        )
+
+    def test_framed_differential_vs_xdrstream(self, tmp_path):
+        """frames=True is byte-identical to what XDROutputFileStream
+        writes record-by-record (the bucket-file wire format)."""
+        from stellar_tpu.util.xdrstream import XDROutputFileStream
+        from stellar_tpu.xdr.base import pack_many
+
+        codec, vals = self._entries(seed=910)
+        path = str(tmp_path / "stream.xdr")
+        with XDROutputFileStream(path) as s:
+            for v in vals:
+                s.write_one(v)
+        with open(path, "rb") as f:
+            expect = f.read()
+        assert pack_many(vals, codec, frames=True) == expect
+
+    def test_accepts_class_iterable_and_empty(self):
+        from stellar_tpu.xdr.entries import LedgerEntry
+        from stellar_tpu.xdr.base import pack_many
+
+        codec, vals = self._entries(n=5, seed=911)
+        joined = b"".join(v.to_xdr() for v in vals)
+        assert pack_many(vals, LedgerEntry) == joined  # class, not codec
+        assert pack_many(iter(vals), codec) == joined  # generator input
+        assert pack_many([], codec) == b""
+        assert pack_many([], codec, frames=True) == b""
+
+    def test_bucketentry_batch_matches_loop(self):
+        """The actual add_batch payload type: mixed live/dead records."""
+        from stellar_tpu.xdr.ledger import (
+            BucketEntry, BucketEntryType, LedgerKey,
+        )
+        from stellar_tpu.ledger.entryframe import ledger_key_of
+        from stellar_tpu.xdr.base import pack_many
+
+        codec, vals = self._entries(n=24, seed=912)
+        batch = []
+        for i, e in enumerate(vals):
+            if i % 3 == 0:
+                batch.append(
+                    BucketEntry(BucketEntryType.DEADENTRY, ledger_key_of(e))
+                )
+            else:
+                batch.append(BucketEntry(BucketEntryType.LIVEENTRY, e))
+        got = pack_many(batch, BucketEntry, frames=True)
+        expect = bytearray()
+        import struct as _struct
+
+        for b in batch:
+            body = b.to_xdr()
+            expect += _struct.pack(">I", len(body) | 0x80000000) + body
+        assert got == bytes(expect)
+
+    @pytest.mark.parametrize("poison", [
+        lambda v: setattr(v, "lastModifiedLedgerSeq", -1),  # uint32 < 0
+        lambda v: setattr(v, "data", None),                 # truncated entry
+        lambda v: setattr(
+            v, "data", X.Asset(9999, None)
+        ),                                                  # foreign type
+    ], ids=["negative-uint32", "missing-union", "foreign-struct"])
+    def test_hostile_element_raises_and_discards_batch(self, poison):
+        """One malformed element anywhere in the batch: XdrError, nothing
+        returned (the partial buffer must not leak out), and the same
+        batch without the poisoned element still packs."""
+        from stellar_tpu.xdr.base import pack_many
+
+        codec, vals = self._entries(n=12, seed=913)
+        poison(vals[7])
+        for frames in (False, True):
+            with pytest.raises(XdrError):
+                pack_many(vals, codec, frames=frames)
+        rest = vals[:7] + vals[8:]
+        assert pack_many(rest, codec) == b"".join(
+            v.to_xdr() for v in rest
+        )
+
+    def test_python_fallback_matches_c(self, monkeypatch):
+        """A stale .so without the pack_many symbol drops pack_many to
+        its per-value Python loop — same octets, framed and unframed."""
+        import stellar_tpu.xdr.base as B
+
+        codec, vals = self._entries(n=10, seed=914)
+        want_plain = B.pack_many(vals, codec)
+        want_framed = B.pack_many(vals, codec, frames=True)
+        real = B._cxdr()
+
+        class StaleSo:
+            def __getattr__(self, name):
+                if name == "pack_many":
+                    raise AttributeError(name)
+                return getattr(real, name)
+
+        monkeypatch.setattr(B, "_cxdr", lambda: StaleSo())
+        assert B.pack_many(vals, codec) == want_plain
+        assert B.pack_many(vals, codec, frames=True) == want_framed

@@ -1,17 +1,25 @@
-"""Close-scoped frame identity map (ledger/framecontext.py).
+"""Close-scoped frame identity map (ledger/framecontext.py) and the
+seal-on-store CoW snapshot plane (ledger/entryframe.py, round 9).
 
 The FrameContext hands out ONE AccountFrame per touched account per close;
-the reference loads a fresh frame per touch.  The contract is therefore
-equivalence: a node with FRAME_CONTEXT=on must produce bit-identical
-ledgers, bit-identical SQL state, AND bit-identical tx/fee history rows
-(including the per-op LedgerEntryChanges metas) to one with it off — for
-payments, fee charging, failed-tx rollbacks, same-close create+pay chains,
-signer mutations, merges, offer crossings, and inflation.  PARANOID_MODE
-audits every close on both sides.
+the reference loads a fresh frame per touch.  Seal-on-store shares the
+storing frame's live entry with the delta/cache/store-buffer instead of
+deep-copying per store.  The contract for BOTH planes is equivalence: a
+node with the knob on must produce bit-identical ledgers, bit-identical
+SQL state, AND bit-identical tx/fee history rows (including the per-op
+LedgerEntryChanges metas) to one with it off — for payments, fee charging,
+failed-tx rollbacks, same-close create+pay chains, signer mutations,
+merges, offer crossings, and inflation.  The differential runner below is
+therefore parametrized over the knob (FRAME_CONTEXT, COW_ENTRY_SNAPSHOTS)
+and PARANOID_MODE audits every close on both sides, with the invariant
+plane all-on (the "aliasing/copy-elision PRs land invariants-green"
+landing policy, ROADMAP Correctness).
 
-Mechanics tests below pin the map itself: identity, savepoint-lockstep
-eviction, the readonly-shell store guard, and the stale-context refusal.
-"""
+Mechanics tests below pin the map itself (identity, savepoint-lockstep
+eviction, the readonly-shell store guard, the stale-context refusal) and
+the seal contract (a sealed entry is never mutated in place — hostile
+mutation attempts must transparently CoW, proven against the shared
+snapshot's bytes)."""
 
 import pytest
 
@@ -47,14 +55,16 @@ def _dump_state(db):
 
 
 class _Runner:
-    """Drive the same close sequence through two apps (frame context on /
-    off) and compare ledger hashes + SQL + history after every close."""
+    """Drive the same close sequence through two apps (`knob` on / off)
+    and compare ledger hashes + SQL + history after every close."""
 
-    def __init__(self, clock, instance_base):
+    KNOBS = {"frame_context": "FRAME_CONTEXT", "cow": "COW_ENTRY_SNAPSHOTS"}
+
+    def __init__(self, clock, instance_base, knob="frame_context"):
         self.apps = []
-        for i, fc in enumerate((True, False)):
+        for i, on in enumerate((True, False)):
             cfg = T.get_test_config(instance_base + i)
-            cfg.FRAME_CONTEXT = fc
+            setattr(cfg, self.KNOBS[knob], on)
             cfg.PARANOID_MODE = True  # audit every close on both sides
             self.apps.append(Application(clock, cfg, new_db=True))
 
@@ -91,9 +101,15 @@ class _Runner:
             app.database.close()
 
 
-@pytest.fixture
-def runner(clock):
-    r = _Runner(clock, 72)
+@pytest.fixture(params=["frame_context", "cow"])
+def runner(clock, request):
+    """Every differential scenario runs twice: FRAME_CONTEXT on/off and
+    COW_ENTRY_SNAPSHOTS on/off (each vs an otherwise-default config) —
+    the two aliasing planes share one equivalence oracle."""
+    r = _Runner(
+        clock, {"frame_context": 72, "cow": 84}[request.param],
+        knob=request.param,
+    )
     yield r
     r.shutdown()
 
@@ -361,6 +377,59 @@ class TestContextMechanics:
         finally:
             app.database.close()
 
+    def test_savepoint_rollback_evicts_sealed_frames(self, clock):
+        """A frame SEALED inside an aborted savepoint scope must be
+        evicted from the identity map (its sealed snapshot belongs to the
+        rolled-back store), and the next load must observe the pre-scope
+        state from the rolled-back cache/SQL planes."""
+        from stellar_tpu.ledger.accountframe import AccountFrame
+        from stellar_tpu.ledger.delta import LedgerDelta
+        from stellar_tpu.ledger.entryframe import key_bytes
+        from stellar_tpu.ledger.framecontext import frame_context_of
+
+        cfg = T.get_test_config(79)
+        app = Application(clock, cfg, new_db=True)
+        try:
+            root = T.root_key_for(app)
+            db = app.database
+            lm = app.ledger_manager
+            ctx = frame_context_of(db)
+            ctx.activate()
+            try:
+                pk = root.get_public_key()
+                f = AccountFrame.load_account(pk, db, signing=True)
+                kb = key_bytes(f.get_key())
+                before = f.get_balance()
+                delta = LedgerDelta(lm.current.header, db)
+
+                class Boom(Exception):
+                    pass
+
+                # the per-tx savepoint must be NESTED inside the close's
+                # outer BEGIN (the real apply shape) — only nested scopes
+                # push frame-context marks; the outermost BEGIN predates
+                # the context activation and unwinds via deactivate
+                with db.transaction():
+                    with pytest.raises(Boom):
+                        with db.transaction():
+                            f.mut().balance -= 1000
+                            f.store_change(delta, db)
+                            assert f._sealed, "store must seal"
+                            raise Boom
+                    delta.rollback()  # what the aborted tx apply does
+                    assert ctx.lend(kb, mutable=True) is None, (
+                        "sealed frame must evict with its savepoint"
+                    )
+                    g = AccountFrame.load_account(pk, db, signing=True)
+                    assert g is not f
+                    assert g.get_balance() == before, (
+                        "post-rollback load must observe pre-scope state"
+                    )
+            finally:
+                ctx.deactivate()
+        finally:
+            app.database.close()
+
     def test_stale_context_frame_refuses_store(self, clock):
         """A frame retained past its close cannot write into a later
         ledger (the store_* refusal machinery extended to context-owned
@@ -384,5 +453,223 @@ class TestContextMechanics:
             delta = LedgerDelta(lm.current.header, db)
             with pytest.raises(RuntimeError, match="stale close-scoped"):
                 f.store_change(delta, db)
+        finally:
+            app.database.close()
+
+
+def _delta_entries(delta):
+    """{key_bytes: shared snapshot} over the delta's created+modified
+    entries (iter_changed yields (LedgerKey, LedgerEntry, created))."""
+    from stellar_tpu.ledger.entryframe import key_bytes
+
+    return {key_bytes(k): e for k, e, _created in delta.iter_changed()}
+
+
+class TestSealOnStoreCoW:
+    """The seal contract (EntryFrame._record / touch): after a store the
+    frame's entry IS the one snapshot shared with the delta, the entry
+    cache, and the store buffer — no code path may mutate that object.
+    Every hostile mutation below must transparently copy-on-write (the
+    shared snapshot's bytes stay fixed) or be a provable no-op."""
+
+    def _app(self, clock, instance, cow=True):
+        cfg = T.get_test_config(instance)
+        cfg.COW_ENTRY_SNAPSHOTS = cow
+        return Application(clock, cfg, new_db=True)
+
+    def _stored_root(self, app):
+        """(frame, kb, delta): the root account freshly stored (sealed)."""
+        from stellar_tpu.ledger.accountframe import AccountFrame
+        from stellar_tpu.ledger.delta import LedgerDelta
+        from stellar_tpu.ledger.entryframe import key_bytes
+
+        root = T.root_key_for(app)
+        db = app.database
+        f = AccountFrame.load_account(root.get_public_key(), db)
+        delta = LedgerDelta(app.ledger_manager.current.header, db)
+        f.store_change(delta, db)
+        return f, key_bytes(f.get_key()), delta
+
+    def test_store_seals_and_shares_one_snapshot(self, clock):
+        from stellar_tpu.ledger.entryframe import cow_stats
+
+        app = self._app(clock, 86)
+        try:
+            s0 = cow_stats()
+            f, kb, delta = self._stored_root(app)
+            assert f._sealed
+            assert cow_stats()["seals"] == s0["seals"] + 1
+            snap = f.entry
+            # ONE object on all three planes
+            hit, peeked = f.cache_of(app.database).peek(kb)
+            assert hit and peeked is snap
+            assert _delta_entries(delta)[kb] is snap
+        finally:
+            app.database.close()
+
+    @pytest.mark.parametrize("mutate", [
+        lambda f: f.mut().balance,
+        lambda f: f.add_balance(-1000),
+        lambda f: f.set_balance(777),
+        lambda f: f.set_seq_num(99),
+        lambda f: setattr(f, "last_modified", f.last_modified + 1),
+    ], ids=["mut", "add_balance", "set_balance", "set_seq_num",
+            "last_modified"])
+    def test_hostile_mutation_copies_never_reaches_snapshot(
+        self, clock, mutate
+    ):
+        """Mutating a sealed frame without reload must CoW: the frame gets
+        a private copy and the shared snapshot's bytes never move."""
+        from stellar_tpu.ledger.entryframe import cow_stats
+
+        app = self._app(clock, 86)
+        try:
+            f, kb, _delta = self._stored_root(app)
+            snap = f.entry
+            snap_bytes = snap.to_xdr()
+            u0 = cow_stats()["unseals"]
+            mutate(f)
+            assert f.entry is not snap, "mutation must un-seal via a copy"
+            assert not f._sealed
+            assert f.account is f.entry.data.value, "typed alias rebound"
+            assert snap.to_xdr() == snap_bytes, (
+                "the shared snapshot was mutated in place!"
+            )
+            assert cow_stats()["unseals"] == u0 + 1
+            # the cache still serves the (consistent) old snapshot until
+            # the next store publishes the new state
+            hit, peeked = f.cache_of(app.database).peek(kb)
+            assert hit and peeked is snap
+        finally:
+            app.database.close()
+
+    def test_restore_without_mutation_is_copy_free(self, clock):
+        """Re-storing an unmutated sealed frame in the same ledger must
+        re-share the same object: the lastModified stamp is a no-op, so
+        no CoW copy is paid (the bench shape's fee-charge store)."""
+        from stellar_tpu.ledger.entryframe import cow_stats
+
+        app = self._app(clock, 86)
+        try:
+            f, kb, delta = self._stored_root(app)
+            snap = f.entry
+            u0 = cow_stats()["unseals"]
+            f.store_change(delta, app.database)
+            assert f.entry is snap, "same-seq re-store must not copy"
+            assert f._sealed
+            assert cow_stats()["unseals"] == u0
+            hit, peeked = f.cache_of(app.database).peek(kb)
+            assert hit and peeked is snap
+        finally:
+            app.database.close()
+
+    def test_mutate_then_restore_publishes_new_snapshot(self, clock):
+        """CoW copy -> mutate -> store: the cache/delta flip to the new
+        object and the old snapshot still holds the pre-mutation state
+        (peek consistency across a seal)."""
+        app = self._app(clock, 86)
+        try:
+            f, kb, delta = self._stored_root(app)
+            old_snap = f.entry
+            old_balance = f.get_balance()
+            f.mut().balance = old_balance - 5000
+            f.store_change(delta, app.database)
+            assert f._sealed and f.entry is not old_snap
+            hit, peeked = f.cache_of(app.database).peek(kb)
+            assert hit and peeked is f.entry
+            assert _delta_entries(delta)[kb] is f.entry
+            assert old_snap.data.value.balance == old_balance
+        finally:
+            app.database.close()
+
+    def test_trustline_seal_contract(self, clock):
+        """The non-account frame classes ride the same base-class seal:
+        TrustFrame mutators (add_balance, set_authorized, mut) must CoW."""
+        import stellar_tpu.xdr as X
+        from stellar_tpu.ledger.delta import LedgerDelta
+        from stellar_tpu.ledger.entryframe import key_bytes
+        from stellar_tpu.ledger.trustframe import TrustFrame
+
+        app = self._app(clock, 86)
+        try:
+            db = app.database
+            root_pk = T.root_key_for(app).get_public_key()
+            issuer = T.get_account("cow-issuer").get_public_key()
+            tf = TrustFrame.make(root_pk, X.Asset.alphanum4(b"USD", issuer))
+            tf.mut().limit = 10**12
+            tf.set_authorized(True)  # fresh line: flags=0 refuses credits
+            delta = LedgerDelta(app.ledger_manager.current.header, db)
+            tf.store_add(delta, db)
+            assert tf._sealed
+            snap = tf.entry
+            snap_bytes = snap.to_xdr()
+            assert tf.add_balance(10**6)
+            assert tf.entry is not snap and not tf._sealed
+            assert tf.trust_line is tf.entry.data.value
+            assert snap.to_xdr() == snap_bytes
+            hit, peeked = tf.cache_of(db).peek(key_bytes(tf.get_key()))
+            assert hit and peeked is snap
+            tf.store_change(delta, db)
+            assert tf._sealed
+            tf.set_authorized(True)
+            assert not tf._sealed, "set_authorized must CoW too"
+        finally:
+            app.database.close()
+
+    def test_context_lend_unseals_mutable_only(self, clock):
+        """FrameContext.lend: a mutable hand-out of a sealed frame pays
+        the CoW copy; a readonly hand-out keeps sharing the sealed entry
+        (and the memoized shell is rebuilt after an un-seal)."""
+        from stellar_tpu.ledger.accountframe import AccountFrame
+        from stellar_tpu.ledger.delta import LedgerDelta
+        from stellar_tpu.ledger.framecontext import frame_context_of
+
+        app = self._app(clock, 86)
+        try:
+            db = app.database
+            pk = T.root_key_for(app).get_public_key()
+            ctx = frame_context_of(db)
+            ctx.activate()
+            try:
+                f = AccountFrame.load_account(pk, db, signing=True)
+                delta = LedgerDelta(app.ledger_manager.current.header, db)
+                f.store_change(delta, db)
+                assert f._sealed
+                sealed_entry = f.entry
+                ro = AccountFrame.load_account(
+                    pk, db, readonly=True, signing=True
+                )
+                assert ro.entry is sealed_entry, (
+                    "readonly shell shares the sealed snapshot (no copy)"
+                )
+                assert f._sealed, "readonly lend must not un-seal"
+                g = AccountFrame.load_account(pk, db, signing=True)
+                assert g is f and not f._sealed
+                assert f.entry is not sealed_entry, "mutable lend CoWs"
+                ro2 = AccountFrame.load_account(
+                    pk, db, readonly=True, signing=True
+                )
+                assert ro2.entry is f.entry, (
+                    "shell rebuilt over the live entry after the un-seal"
+                )
+            finally:
+                ctx.deactivate()
+        finally:
+            app.database.close()
+
+    def test_cow_off_restores_eager_copies(self, clock):
+        """COW_ENTRY_SNAPSHOTS=False: stores never seal and the cache
+        line is an independent deep copy of the frame's entry."""
+        from stellar_tpu.ledger.entryframe import cow_stats
+
+        app = self._app(clock, 87, cow=False)
+        try:
+            s0 = cow_stats()["seals"]
+            f, kb, _delta = self._stored_root(app)
+            assert not f._sealed
+            assert cow_stats()["seals"] == s0
+            hit, peeked = f.cache_of(app.database).peek(kb)
+            assert hit and peeked is not f.entry
+            assert peeked.to_xdr() == f.entry.to_xdr()
         finally:
             app.database.close()

@@ -380,3 +380,86 @@ class TestVerifierPaths:
         assert again[0] is bufs[0]
         assert pool.acquire(64)[0] is not bufs[0]  # pool drained: fresh
         pool.release(None)  # no-op
+
+
+class TestSodiumVerifyPool:
+    """The pure-CPU fallback leg (round 9): sodium_verify fans libsodium's
+    crypto_sign_verify_detached over the worker pool with the GIL
+    released.  Verdicts must be byte-identical to the serial
+    sodium.verify_detached loop — valid, corrupted, and wrong-length
+    items — across the inline and pooled paths."""
+
+    def _batch(self, n=300, seed=41):
+        rng = random.Random(seed)
+        items = []
+        for i in range(n):
+            sk = SecretKey.pseudo_random_for_testing(7000 + i)
+            msg = bytes(rng.getrandbits(8) for _ in range(rng.randrange(120)))
+            sig = bytearray(sk.sign(msg))
+            pk = sk.public_raw
+            r = i % 5
+            if r == 1:
+                sig[rng.randrange(64)] ^= 1 << rng.randrange(8)  # corrupt
+            elif r == 2:
+                msg = msg + b"!"  # verify different message
+            elif r == 3:
+                sig = sig[:40]  # wrong sig length -> False precheck
+            elif r == 4:
+                pk = pk[:31]  # wrong pk length -> False precheck
+            items.append((pk, bytes(msg), bytes(sig)))
+        return items
+
+    def _run(self, items, threads=0):
+        from stellar_tpu.crypto import sodium
+
+        ok = bytearray(len(items))
+        sighash.sodium_verify(sodium.verify_fn_addr(), items, ok, threads)
+        return [bool(b) for b in ok]
+
+    def test_differential_vs_serial_loop(self):
+        from stellar_tpu.crypto import sodium
+
+        items = self._batch()
+        want = [sodium.verify_detached(s, m, p) for p, m, s in items]
+        assert self._run(items, threads=0) == want  # pooled (n >= 64)
+        assert self._run(items, threads=1) == want  # forced inline
+        assert any(want) and not all(want)
+
+    def test_sigbackend_native_leg_matches_python_pool(self):
+        """crypto/sigbackend routes big batches through the native pool;
+        the returned verdicts must equal the serial-loop contract (the
+        cpu_count()==1 / small-batch path stays the untouched loop)."""
+        from stellar_tpu.crypto import sigbackend, sodium
+
+        items = self._batch(n=280, seed=42)
+        got = sigbackend._sodium_verify_native(items)
+        assert got is not None
+        assert got == [
+            sodium.verify_detached(s, m, p) for p, m, s in items
+        ]
+        assert sigbackend._sodium_verify_loop(items) == got
+
+    def test_non_bytes_item_falls_back(self):
+        """A non-bytes buffer in the batch makes the native leg decline
+        (return None) so the Python loop handles it."""
+        from stellar_tpu.crypto import sigbackend
+
+        items = self._batch(n=257, seed=43)
+        pk, msg, sig = items[100]
+        items[100] = (pk, bytearray(msg), sig)  # not bytes
+        assert sigbackend._sodium_verify_native(items) is None
+
+    def test_argument_validation(self):
+        from stellar_tpu.crypto import sodium
+
+        items = self._batch(n=4, seed=44)
+        with pytest.raises(ValueError):  # null fn pointer
+            sighash.sodium_verify(0, items, bytearray(4))
+        with pytest.raises(ValueError):  # ok buffer too small
+            sighash.sodium_verify(
+                sodium.verify_fn_addr(), items, bytearray(3)
+            )
+        with pytest.raises(TypeError):  # malformed item tuple
+            sighash.sodium_verify(
+                sodium.verify_fn_addr(), [(b"a", b"b")], bytearray(1)
+            )
