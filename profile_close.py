@@ -10,6 +10,7 @@ ledger-close p50 (BASELINE.md second headline metric).  Usage:
     python profile_close.py fcab [n_txs] [n_ledgers]     # frame-context A/B
     python profile_close.py cowab [n_txs] [n_ledgers]    # CoW-snapshot A/B
     python profile_close.py --copy-report [n_txs] [n_ledgers]  # xdr_copy sites
+    python profile_close.py --pipeline-report [n_txs] [n_ledgers]  # close-pipeline A/B
     python profile_close.py --assert-budget [ms] [n_txs] # regression gate
 """
 
@@ -25,10 +26,10 @@ import time
 
 
 def _make_app(instance, n_txs, buffered=True, frame_context=True, cow=True,
-              paranoid=False):
+              paranoid=False, pipeline=True, sampled=True, real_time=False):
     from stellar_tpu.main.application import Application
     from stellar_tpu.tx import testutils as T
-    from stellar_tpu.util.clock import VirtualClock
+    from stellar_tpu.util.clock import REAL_TIME, VirtualClock
 
     cfg = T.get_test_config(instance, backend="cpu")
     cfg.DESIRED_MAX_TX_PER_LEDGER = n_txs * 2
@@ -36,12 +37,16 @@ def _make_app(instance, n_txs, buffered=True, frame_context=True, cow=True,
     cfg.FRAME_CONTEXT = frame_context
     cfg.COW_ENTRY_SNAPSHOTS = cow
     cfg.PARANOID_MODE = paranoid
+    cfg.CLOSE_PIPELINE = pipeline
     # invariant plane in SAMPLED mode, matching bench.py: this harness's
     # round-over-round p50s (and the close_budget regression gate) must
     # stay comparable with pre-r08 numbers — the all-on cost is tracked
-    # separately as bench.py's invariant_overhead_ms
-    cfg.INVARIANT_SAMPLED = True
-    clock = VirtualClock()
+    # separately as bench.py's invariant_overhead_ms.  --pipeline-report
+    # overrides to ALL-ON (its acceptance contract audits every close).
+    cfg.INVARIANT_SAMPLED = sampled
+    # span durations need a real clock (a virtual one stamps every span
+    # with an unmoving now()); only the trace-reading modes ask for it
+    clock = VirtualClock(REAL_TIME) if real_time else VirtualClock()
     return Application.create(clock, cfg, new_db=True), clock
 
 
@@ -393,6 +398,126 @@ def copy_report(n_txs=5000, n_ledgers=3, both=True):
         print("\nfinal ledger hashes match")
 
 
+def pipeline_report(n_txs=5000, n_ledgers=3, both=True):
+    """Paired CLOSE_PIPELINE on/off A/B with per-phase overlap accounting
+    (the r10 acceptance harness).  Both legs run PARANOID with the
+    invariant plane ALL-ON and drive the same payment closes; the ON leg
+    registers round j+1's tx bag as a prewarm candidate before round j
+    closes (the herder hand-off seam, ledger/closepipeline.py), so the
+    signature verify for j+1 runs while j applies.  Prints, per leg, the
+    close-phase p50s plus the pipeline's own overlap ledger (dispatched/
+    joined/warm, hidden ms, join-wait ms), then the residual sig-verify
+    cost inside the close both ways and the reduction.  Ledger hashes,
+    SQL dumps, and tx/fee-history metas are asserted bit-exact between
+    legs."""
+    from stellar_tpu.tx import testutils as T
+
+    def leg(instance, pipeline):
+        app, clock = _make_app(
+            instance, n_txs, pipeline=pipeline, paranoid=True,
+            sampled=False, real_time=True,
+        )
+        try:
+            accounts = [T.get_account(i + 1) for i in range(n_txs + 1)]
+            created_at = _populate(app, accounts, n_txs)
+            # tx bags carry no ledger linkage — build every round up
+            # front so the ON leg can register j+1 before j closes
+            round_txs = [
+                _payment_txs(app, accounts, created_at, n_txs, j)
+                for j in range(n_ledgers)
+            ]
+            app.tracer.clear()  # spans must describe ONLY the timed closes
+            # the verify cache is process-global (keys.py gVerifySigCache
+            # shape): the legs drive IDENTICAL txs, so leg A would warm
+            # leg B's flushes and fake its residual to ~0.  Each leg
+            # starts cold.
+            from stellar_tpu.crypto.keys import PubKeyUtils
+
+            PubKeyUtils.clear_verify_sig_cache()
+            pipe = app.close_pipeline if pipeline else None
+            times = []
+            for j in range(n_ledgers):
+                if pipe is not None and j + 1 < n_ledgers:
+                    pipe.note_upcoming(round_txs[j + 1])
+                total_s, _close_s = _drive_close(app, round_txs[j])
+                times.append(total_s)
+            agg = app.tracer.aggregates()
+            phases = {
+                name: round(agg[name]["p50_ms"], 2)
+                for name in (
+                    "ledger.close", "close.sig_flush", "close.fees",
+                    "close.apply", "close.commit", "close.pipeline.dispatch",
+                    "close.pipeline.join", "txset.validate", "sig.flush",
+                )
+                if name in agg
+            }
+            stats = pipe.stats() if pipe is not None else None
+            inv = app.invariants
+            assert inv.total_violations == 0, inv.dump_info()
+            assert inv.closes_checked >= n_ledgers
+            return (
+                statistics.median(times), phases, stats,
+                app.ledger_manager.last_closed.hash,
+                T.dump_state(app.database),  # the shared bit-exactness oracle
+            )
+        finally:
+            app.graceful_stop()
+            clock.shutdown()
+
+    def residual_ms(phases, stats):
+        """The sig-verify wall the externalize→close path pays
+        SYNCHRONOUSLY per ledger.  The check_valid prewarm's flush
+        (sig.flush span: the full batch verify inline; an all-hit cache
+        peek once the pipeline prewarmed it) plus the close's own
+        sig_flush — the join wait when pipelined, whatever the nested fee
+        pass did not hide when inline."""
+        flush = phases.get("sig.flush", 0.0)
+        if stats is not None:
+            return flush + phases.get("close.sig_flush", 0.0)
+        return flush + max(
+            0.0,
+            phases.get("close.sig_flush", 0.0) - phases.get("close.fees", 0.0),
+        )
+
+    def report(tag, p50, phases, stats):
+        print(f"\n== pipeline {tag}: total p50 {p50 * 1e3:.0f} ms over"
+              f" {n_ledgers} closes of {n_txs} txs ==")
+        for name, ms in sorted(phases.items()):
+            print(f"  {name:<24} {ms:>9.2f} ms p50")
+        print(f"  sig-verify residual in close: {residual_ms(phases, stats):.2f} ms p50")
+        if stats is not None:
+            print(
+                f"  pipeline: dispatched {stats['dispatched']},"
+                f" joined {stats['joined']} (warm {stats['joined_warm']}),"
+                f" quarantined {stats['quarantined']},"
+                f" hidden {stats['overlap_hidden_ms']:.1f} ms,"
+                f" join wait {stats['join_wait_ms']:.1f} ms,"
+                f" dispatch {stats['dispatch_ms']:.1f} ms"
+            )
+
+    p50_on, ph_on, st_on, h_on, sql_on = leg(86, True)
+    report("ON", p50_on, ph_on, st_on)
+    if not both:
+        return 0
+    p50_off, ph_off, st_off, h_off, sql_off = leg(87, False)
+    report("OFF", p50_off, ph_off, st_off)
+    assert h_on == h_off, "ledger hash diverged between pipeline modes!"
+    assert sql_on == sql_off, (
+        "SQL state (entries or history metas) diverged between pipeline modes!"
+    )
+    print("\nfinal ledger hashes + SQL dumps + history metas bit-exact")
+    r_on, r_off = residual_ms(ph_on, st_on), residual_ms(ph_off, st_off)
+    if r_off > 0:
+        red = 100.0 * (1.0 - r_on / r_off)
+        print(
+            f"residual sig-verify inside close: {r_off:.2f} ms -> "
+            f"{r_on:.2f} ms ({red:.0f}% reduction; acceptance >= 80%)"
+        )
+        return 0 if red >= 80.0 else 1
+    print("off-leg residual ~0 (fees already hid the flush at this scale)")
+    return 0
+
+
 def assert_budget(budget_ms=2000.0, n_txs=5000, n_ledgers=3):
     """Close-regression gate: clean (unprofiled) p50 of the standard
     close drive, exit nonzero when it exceeds the budget.  relay_watch.py
@@ -442,6 +567,15 @@ if __name__ == "__main__":
             int(rest[0]) if rest else 5000,
             int(rest[1]) if len(rest) > 1 else 3,
             both="--single" not in args,
+        )
+    elif args and args[0] == "--pipeline-report":
+        rest = [a for a in args[1:] if a != "--single"]
+        sys.exit(
+            pipeline_report(
+                int(rest[0]) if rest else 5000,
+                int(rest[1]) if len(rest) > 1 else 3,
+                both="--single" not in args,
+            )
         )
     elif args and args[0] == "--assert-budget":
         sys.exit(

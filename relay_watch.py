@@ -95,6 +95,16 @@ FINAL_STEPS = [
     ("cow_close_r09",
      [sys.executable, "-u", "profile_close.py", "--copy-report", "5000", "3"],
      2400),
+    # r10: certify the close pipeline in a quiet green window — paired
+    # same-window CLOSE_PIPELINE on/off A/B with per-phase overlap
+    # accounting (sig_flush residual, apply wall, hidden ms) + final
+    # hash/SQL/meta equality; exits nonzero when the residual reduction
+    # misses the >=80% acceptance (the ISSUE r10 drive; bench.py's
+    # overlap_hidden_ms carries the trajectory on every close line)
+    ("pipeline_close_r10",
+     [sys.executable, "-u", "profile_close.py", "--pipeline-report",
+      "5000", "3"],
+     2400),
 ]
 ALL_NAMES = (
     [s[0] for s in SCRIPT_STEPS]

@@ -29,6 +29,89 @@ _log = xlog.logger("Tx")
 
 VerifyTriple = Tuple[bytes, bytes, bytes]  # (pubkey32, msg, sig64)
 
+# Caller classes for the tpu backend's host-fallback latch (and the async
+# flush plane's attribution): a stalled PIPELINED prewarm must never route
+# subsequent SYNCHRONOUS close-path batches onto host — the latch is scoped
+# per class (ISSUE r10 satellite; see TpuSigBackend.verify_batch).
+CALLER_CLOSE = "close"        # synchronous close-path / check_valid flushes
+CALLER_PIPELINE = "pipeline"  # close-pipeline async prewarms (ledger N+1)
+CALLER_OVERLAY = "overlay"    # per-crank SCP envelope batch flushes
+
+
+class SigFlushFuture:
+    """Handle to one in-flight asynchronous batch verify — the unit the
+    close-pipeline scheduler dispatches while ledger N applies and joins at
+    the top of ledger N+1's close.
+
+    Lifecycle: ``dispatch`` (worker starts) → ``complete`` (verdicts ready;
+    a caching backend latches them into the shared verify cache at this
+    point, never earlier) → ``result()`` (join; re-raises a worker error).
+    ``quarantine()`` severs the future from the cache plane: verdicts from
+    a quarantined batch are never latched, and any already latched are
+    evicted — an aborted/forked close must not leave its in-flight flush's
+    writes behind (the contract tests/test_closepipeline.py pins).
+
+    Timestamps (``time.monotonic``) let the scheduler account overlap:
+    ``completed_at - dispatched_at`` is the async verify's duration; the
+    part of it that elapsed before the join is hidden work."""
+
+    def __init__(self, n_items: int):
+        self.items = n_items
+        self.dispatched_at = time.monotonic()
+        self.completed_at: Optional[float] = None
+        self._done = threading.Event()
+        self._lock = threading.Lock()
+        self._result: Optional[List[bool]] = None
+        self._err: Optional[BaseException] = None
+        self._quarantined = False
+        # set by CachingSigBackend before dispatch: (cache, [(key, idx)...])
+        # mapping miss keys to result rows — the latch happens inside
+        # _complete under the future's lock so quarantine() can never race
+        # a put_many it doesn't see
+        self._latch = None
+        self._latched = False
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def quarantined(self) -> bool:
+        return self._quarantined
+
+    def quarantine(self) -> None:
+        """Disown the batch: results will not (and no longer do) back the
+        shared verify cache.  Idempotent; safe in any state."""
+        with self._lock:
+            self._quarantined = True
+            if self._latched and self._latch is not None:
+                cache, key_rows = self._latch
+                cache.drop_many(k for k, _ in key_rows)
+                self._latched = False
+
+    def _complete(self, result=None, err=None) -> None:
+        with self._lock:
+            self.completed_at = time.monotonic()
+            if err is not None:
+                self._err = err
+            else:
+                self._result = result
+                if self._latch is not None and not self._quarantined:
+                    cache, key_rows = self._latch
+                    cache.put_many((k, result[i]) for k, i in key_rows)
+                    self._latched = True
+        self._done.set()
+
+    def result(self, timeout: Optional[float] = None) -> List[bool]:
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"sig-flush future ({self.items} items) not done in {timeout}s"
+            )
+        with self._lock:
+            if self._quarantined:
+                raise RuntimeError("sig-flush future was quarantined")
+            if self._err is not None:
+                raise self._err
+            return self._result
+
 # Default device/host breakeven for the tpu backend, in cache-miss verifies:
 # n/host_rate = rtt + n/device_rate at the MEASURED relay (68 ms RTT, 230k/s
 # device, 16k/s host core) gives n ≈ 1,100.  Locally-attached TPU (sub-ms
@@ -40,8 +123,30 @@ DEFAULT_TPU_CPU_CUTOVER = 1024
 class SigBackend:
     name = "abstract"
 
-    def verify_batch(self, items: Sequence[VerifyTriple]) -> List[bool]:
+    def verify_batch(
+        self, items: Sequence[VerifyTriple], caller: str = CALLER_CLOSE
+    ) -> List[bool]:
         raise NotImplementedError
+
+    def verify_batch_async(
+        self, items: Sequence[VerifyTriple], caller: str = CALLER_PIPELINE
+    ) -> SigFlushFuture:
+        """Dispatch the batch on a worker thread and return a future over
+        it — the stage/drain split promoted to the backend surface, so a
+        caller (ledger close, bench's deferred-flush leg) can overlap the
+        verify with its own host work and join later.  Uncached backends
+        just run verify_batch off-thread; CachingSigBackend adds the
+        peek/latch split (and the quarantine contract) on top."""
+        fut = SigFlushFuture(len(items))
+
+        def work():
+            try:
+                fut._complete(result=self.verify_batch(items, caller=caller))
+            except BaseException as e:  # re-raised at fut.result()
+                fut._complete(err=e)
+
+        threading.Thread(target=work, name="sig-flush", daemon=True).start()
+        return fut
 
     def stats(self) -> dict:
         return {}
@@ -58,7 +163,9 @@ class CachingSigBackend(SigBackend):
         self.name = inner.name
         self._tracer = tracer if tracer is not None else NULL_TRACER
 
-    def verify_batch(self, items: Sequence[VerifyTriple]) -> List[bool]:
+    def verify_batch(
+        self, items: Sequence[VerifyTriple], caller: str = CALLER_CLOSE
+    ) -> List[bool]:
         # one sig-flush span per batch (never per item): batch size and the
         # cache-hit/miss split are THE attribution the close trace needs
         sp = self._tracer.begin("sig.flush")
@@ -66,7 +173,9 @@ class CachingSigBackend(SigBackend):
         cached = self.cache.peek_many(keys)
         miss_idx = [i for i, c in enumerate(cached) if c is None]
         if miss_idx:
-            fresh = self.inner.verify_batch([items[i] for i in miss_idx])
+            fresh = self.inner.verify_batch(
+                [items[i] for i in miss_idx], caller=caller
+            )
             self.cache.put_many(
                 (keys[i], ok) for i, ok in zip(miss_idx, fresh)
             )
@@ -80,6 +189,53 @@ class CachingSigBackend(SigBackend):
             backend=self.name,
         )
         return [bool(c) for c in cached]
+
+    def verify_batch_async(
+        self, items: Sequence[VerifyTriple], caller: str = CALLER_PIPELINE
+    ) -> SigFlushFuture:
+        """The async flush over the peek/verify/latch split, ENTIRELY on
+        the worker: key hashing, the cache peek, the miss verify, and the
+        at-completion scatter-back all run off the caller's thread — the
+        dispatching close overlaps every pure-compute part of the flush
+        with its own host work (the caller only pays the list snapshot +
+        thread spawn).  The latch rides the future, so a quarantined
+        (aborted-close) batch can never leave verdicts behind."""
+        items = list(items)
+        fut = SigFlushFuture(len(items))
+
+        def work():
+            sp = self._tracer.begin("sig.flush_async")
+            try:
+                keys = [
+                    self.cache.key_for(pk, sig, msg) for pk, msg, sig in items
+                ]
+                cached = self.cache.peek_many(keys)
+                miss_idx = [i for i, c in enumerate(cached) if c is None]
+                self._tracer.end(
+                    sp,
+                    batch=len(items),
+                    cache_hits=len(items) - len(miss_idx),
+                    misses=len(miss_idx),
+                    backend=self.name,
+                )
+                if not miss_idx:
+                    fut._complete(result=[bool(c) for c in cached])
+                    return
+                # plain attribute store is atomic; _complete reads it
+                # under fut._lock and skips the latch if a quarantine won
+                fut._latch = (self.cache, [(keys[i], i) for i in miss_idx])
+                fresh = self.inner.verify_batch(
+                    [items[i] for i in miss_idx], caller=caller
+                )
+                merged = list(cached)
+                for i, ok in zip(miss_idx, fresh):
+                    merged[i] = ok
+                fut._complete(result=[bool(c) for c in merged])
+            except BaseException as e:  # re-raised at fut.result()
+                fut._complete(err=e)
+
+        threading.Thread(target=work, name="sig-flush", daemon=True).start()
+        return fut
 
     def stats(self) -> dict:
         return self.inner.stats()
@@ -176,7 +332,9 @@ def _sodium_verify_loop(items: Sequence[VerifyTriple]) -> List[bool]:
 class CpuSigBackend(SigBackend):
     name = "cpu"
 
-    def verify_batch(self, items: Sequence[VerifyTriple]) -> List[bool]:
+    def verify_batch(
+        self, items: Sequence[VerifyTriple], caller: str = CALLER_CLOSE
+    ) -> List[bool]:
         return _sodium_verify_loop(items)
 
 
@@ -220,7 +378,14 @@ class TpuSigBackend(SigBackend):
         self.cpu_cutover = cpu_cutover
         self.n_cutover_items = 0
         self.n_wedge_fallback_items = 0
-        self._wedged_until = 0.0
+        # Host-fallback latch, scoped PER CALLER CLASS (ISSUE r10): a
+        # stalled pipelined prewarm (caller="pipeline") latches only the
+        # pipeline plane — the synchronous close-path batches
+        # (caller="close") keep probing the device, and vice versa.  A
+        # single shared latch silently routed every subsequent close flush
+        # onto host for RETRY_INTERVAL after one stalled async prewarm.
+        self._wedged_until: dict = {}
+        self.n_latch_flips: dict = {}
         # verify_batch is called concurrently (async signature prewarm
         # worker + the SCP crank); the latch read/write and the budget
         # choice go under one small lock so callers see consistent state
@@ -247,7 +412,9 @@ class TpuSigBackend(SigBackend):
     DEVICE_FIRST_TIMEOUT = _env_float("STELLAR_TPU_FIRST_DISPATCH_BUDGET", 90.0)
     RETRY_INTERVAL = 60.0
 
-    def verify_batch(self, items: Sequence[VerifyTriple]) -> List[bool]:
+    def verify_batch(
+        self, items: Sequence[VerifyTriple], caller: str = CALLER_CLOSE
+    ) -> List[bool]:
         if len(items) < self.cpu_cutover:
             self.n_cutover_items += len(items)
             with self._tracer.span(
@@ -258,7 +425,7 @@ class TpuSigBackend(SigBackend):
         # never the verify work itself, or every concurrent caller inherits
         # the slowest batch's host-verify latency
         with self._wedge_lock:
-            wedged = time.monotonic() < self._wedged_until
+            wedged = time.monotonic() < self._wedged_until.get(caller, 0.0)
             # every caller keeps the long budget until the first device call
             # has COMPLETED (not merely been dispatched): a second caller
             # arriving mid-compile rides the same XLA compile and must not
@@ -267,7 +434,10 @@ class TpuSigBackend(SigBackend):
         if wedged:
             self.n_wedge_fallback_items += len(items)
             with self._tracer.span(
-                "sig.host_verify", items=len(items), reason="wedge-latch"
+                "sig.host_verify",
+                items=len(items),
+                reason="wedge-latch",
+                caller=caller,
             ):
                 return _sodium_verify_loop(items)
         result: List[Any] = [None]
@@ -287,19 +457,31 @@ class TpuSigBackend(SigBackend):
         timeout = self.DEVICE_FIRST_TIMEOUT if first else self.DEVICE_TIMEOUT
         if not done.wait(timeout):
             with self._wedge_lock:
-                self._wedged_until = time.monotonic() + self.RETRY_INTERVAL
+                # latch flips are metered per caller class so telemetry
+                # (stats() → /info) shows WHICH plane is riding host
+                self._wedged_until[caller] = (
+                    time.monotonic() + self.RETRY_INTERVAL
+                )
+                self.n_latch_flips[caller] = (
+                    self.n_latch_flips.get(caller, 0) + 1
+                )
             self.n_wedge_fallback_items += len(items)
             _log.warning(
                 "device verify batch stalled >%.0fs; finishing %d verifies"
-                " on host and latching onto host for %.0fs",
+                " on host and latching the %r caller class onto host for"
+                " %.0fs",
                 timeout,
                 len(items),
+                caller,
                 self.RETRY_INTERVAL,
             )
             # the orphaned worker's eventual completion is harmless: the
             # caller-side cache scatter-back writes identical values
             with self._tracer.span(
-                "sig.host_verify", items=len(items), reason="device-stall"
+                "sig.host_verify",
+                items=len(items),
+                reason="device-stall",
+                caller=caller,
             ):
                 return _sodium_verify_loop(items)
         if err:
@@ -310,6 +492,7 @@ class TpuSigBackend(SigBackend):
         s = self._verifier.stats()
         s["cpu_cutover_items"] = self.n_cutover_items
         s["wedge_fallback_items"] = self.n_wedge_fallback_items
+        s["wedge_latch_flips"] = dict(self.n_latch_flips)
         return s
 
 

@@ -68,6 +68,14 @@ class VerifySigCache:
             while len(self._map) > self.capacity:
                 self._map.popitem(last=False)
 
+    def drop_many(self, keys) -> None:
+        """Evict entries (quarantine path: verdicts latched by an async
+        flush whose close was aborted are withdrawn — see
+        SigFlushFuture.quarantine)."""
+        with self._lock:
+            for k in keys:
+                self._map.pop(k, None)
+
     def flush_counts(self) -> Tuple[int, int]:
         with self._lock:
             h, m = self._hits, self._misses

@@ -153,44 +153,32 @@ class TxSetFrame:
             backend.verify_batch(triples)
 
     def prewarm_signature_cache_async(self, app):
-        """Start the signature-cache prewarm on a worker thread; returns a
-        join() the caller must invoke before any signature check can depend
-        on the warmed cache.
+        """Start the signature-cache prewarm via the backend's async flush
+        surface (SigBackend.verify_batch_async); returns a join() the
+        caller must invoke before any signature check can depend on the
+        warmed cache.
 
         Triple collection (DB reads via candidate_signature_pairs) happens
         on the CALLER's thread — sqlite connections are not shared across
         threads here.  Only the pure-compute flush (hashing + device/
-        libsodium verify + locked cache scatter-back, VerifySigCache) runs
+        libsodium verify + at-completion cache latch, SigFlushFuture) runs
         on the worker, which lets ledger close overlap it with fee
-        processing (LedgerManager.close_ledger)."""
+        processing (LedgerManager.close_ledger).
+
+        join() is bounded even through a wedged accelerator transport:
+        TpuSigBackend.verify_batch carries its own DEVICE_TIMEOUT + host
+        fallback (covering every call site, not just this one); a worker
+        error re-raises at join()."""
+        from ..crypto.sigbackend import CALLER_CLOSE
+
         backend = getattr(app, "sig_backend", None)
-        if backend is None:
+        if backend is None or not hasattr(backend, "verify_batch_async"):
             return lambda: None
         triples = self._collect_signature_triples(app)
         if not triples:
             return lambda: None
-        import threading
-
-        err: List[BaseException] = []
-
-        def work():
-            try:
-                backend.verify_batch(triples)
-            except BaseException as e:  # re-raised at join()
-                err.append(e)
-
-        t = threading.Thread(target=work, name="sig-prewarm", daemon=True)
-        t.start()
-
-        # join() is bounded even through a wedged accelerator transport:
-        # TpuSigBackend.verify_batch carries its own DEVICE_TIMEOUT + host
-        # fallback (covering every call site, not just this one)
-        def join():
-            t.join()
-            if err:
-                raise err[0]
-
-        return join
+        fut = backend.verify_batch_async(triples, caller=CALLER_CLOSE)
+        return fut.result
 
     def _account_tx_map(self) -> Dict[bytes, List[TransactionFrame]]:
         m: Dict[bytes, List[TransactionFrame]] = {}

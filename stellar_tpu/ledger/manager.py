@@ -190,19 +190,43 @@ class LedgerManager:
             )
 
     # -- externalize path (LedgerManagerImpl.cpp:321-408) ------------------
+    def _close_pipeline(self):
+        """The close-pipeline scheduler, or None when the knob is off —
+        callers fall back to the reference-style inline close."""
+        if not getattr(self.app.config, "CLOSE_PIPELINE", True):
+            return None
+        return getattr(self.app, "close_pipeline", None)
+
+    def _close_externalized(self, ledger_data) -> None:
+        """One externalized ledger's close + the post-close notifications
+        (shared by the inline path and the pipeline drain)."""
+        self.close_ledger(ledger_data)
+        if self.state == LedgerState.LM_BOOTING_STATE:
+            # a failed catchup round left us unsynced, but the network
+            # delivered the next ledger in order after all
+            self.state = LedgerState.LM_SYNCED_STATE
+        self.app.herder_notify_ledger_closed()
+
     def externalize_value(self, ledger_data) -> None:
         if self.state == LedgerState.LM_CATCHING_UP_STATE:
             # keep buffering while the catchup FSM runs (:389-399)
             self.syncing_ledgers.append(ledger_data)
             return
-        if ledger_data.ledger_seq == self.last_closed.header.ledgerSeq + 1:
-            self.close_ledger(ledger_data)
-            if self.state == LedgerState.LM_BOOTING_STATE:
-                # a failed catchup round left us unsynced, but the network
-                # delivered the next ledger in order after all
-                self.state = LedgerState.LM_SYNCED_STATE
-            self.app.herder_notify_ledger_closed()
-        elif ledger_data.ledger_seq <= self.last_closed.header.ledgerSeq:
+        pipe = self._close_pipeline()
+        # with the pipeline on, externalized ledgers may be queued but not
+        # yet closed: "next" means next after the queue's tail, and those
+        # extra sequences enqueue instead of looking like a gap — the
+        # drain below closes them in order, prewarming N+1's signatures
+        # while N applies (closepipeline.py)
+        queued = pipe.queued_count() if pipe is not None else 0
+        next_seq = self.last_closed.header.ledgerSeq + 1 + queued
+        if ledger_data.ledger_seq == next_seq:
+            if pipe is not None:
+                pipe.enqueue(ledger_data)
+                pipe.drain(self._close_externalized)
+            else:
+                self._close_externalized(ledger_data)
+        elif ledger_data.ledger_seq < next_seq:
             log.debug("skipping old ledger %d", ledger_data.ledger_seq)
         else:
             # gap: buffer and catch up (SURVEY §3.4)
@@ -215,6 +239,12 @@ class LedgerManager:
             self.start_catchup()
 
     def start_catchup(self, mode: Optional[str] = None) -> None:
+        pipe = self._close_pipeline()
+        if pipe is not None:
+            # catchup interrupt: in-flight prewarm futures quarantine (the
+            # cache must not keep verdicts from a plane that just forked)
+            # and queued-but-unclosed ledgers move into the catchup buffer
+            self.syncing_ledgers.extend(pipe.interrupt())
         self.state = LedgerState.LM_CATCHING_UP_STATE
         self.app.request_catchup()
         self.app.history_manager.catchup_history(mode=mode)
@@ -266,11 +296,27 @@ class LedgerManager:
         buffered = sorted(self.syncing_ledgers, key=lambda l: l.ledger_seq)
         self.syncing_ledgers.clear()
         still_ahead = []
-        for ld in buffered:
-            if ld.ledger_seq == self.last_closed.header.ledgerSeq + 1:
-                self.close_ledger(ld)
-            elif ld.ledger_seq > self.last_closed.header.ledgerSeq:
-                still_ahead.append(ld)
+        pipe = self._close_pipeline()
+        if pipe is not None:
+            # the replay backlog is THE pipelined-close shape: enqueue the
+            # whole contiguous run first, then drain — while ledger N
+            # applies, N+1's signature flush verifies on a worker
+            expected = self.last_closed.header.ledgerSeq + 1
+            for ld in buffered:
+                if ld.ledger_seq == expected:
+                    pipe.enqueue(ld)
+                    expected += 1
+                elif ld.ledger_seq >= expected:
+                    still_ahead.append(ld)
+            # close_ledger (not _close_externalized): the replay notifies
+            # the herder ONCE at the end, matching the inline path below
+            pipe.drain(self.close_ledger)
+        else:
+            for ld in buffered:
+                if ld.ledger_seq == self.last_closed.header.ledgerSeq + 1:
+                    self.close_ledger(ld)
+                elif ld.ledger_seq > self.last_closed.header.ledgerSeq:
+                    still_ahead.append(ld)
         if still_ahead:
             # network moved past the archive anchor while we fetched:
             # go around again (reference restarts the catchup round)
@@ -312,6 +358,12 @@ class LedgerManager:
             cache = getattr(self.database, "_entry_cache", None)
             if cache is not None:
                 cache.clear()
+            # and any in-flight pipelined sig flushes dispatched by this
+            # (now aborted) close quarantine: their verdicts must never
+            # latch into — or remain in — the shared verify cache
+            pipe = self._close_pipeline()
+            if pipe is not None:
+                pipe.abort_inflight()
             raise
 
     def _close_ledger_txn(self, ledger_data) -> None:
@@ -371,17 +423,40 @@ class LedgerManager:
                 # pre-warm the verify cache for the whole set in one batch,
                 # overlapped with fee processing (signature checks only
                 # start at apply, after the join) — at apply every check hits.
-                # The sig_flush span covers prewarm start → join, so the
-                # nested close.fees span shows how much of it the fee pass
-                # hid (the residual is the close's real sig-verify cost)
+                # With the close pipeline, the join point is the TOP of the
+                # close: if the previous ledger's apply already hid this
+                # set's verify (closepipeline.py), close.sig_flush shrinks
+                # to the join wait — the close's true residual sig cost.
+                # Otherwise the sig_flush span covers prewarm start → join
+                # with close.fees nested, so fees show how much it hid.
+                pipe = self._close_pipeline()
                 sig_sp = tracer.begin("close.sig_flush", txs=len(txs))
-                join_prewarm = ledger_data.tx_set.prewarm_signature_cache_async(
-                    self.app
+                pipelined = (
+                    pipe.join_prewarm(ledger_data.tx_set, tracer)
+                    if pipe is not None
+                    else False
                 )
-                with tracer.span("close.fees", txs=len(txs)):
-                    self._process_fees_seq_nums(txs, ledger_delta)
-                join_prewarm()
-                tracer.end(sig_sp)
+                if pipelined:
+                    tracer.end(sig_sp, pipelined=True)
+                    with tracer.span("close.fees", txs=len(txs)):
+                        self._process_fees_seq_nums(txs, ledger_delta)
+                else:
+                    join_prewarm = (
+                        ledger_data.tx_set.prewarm_signature_cache_async(
+                            self.app
+                        )
+                    )
+                    with tracer.span("close.fees", txs=len(txs)):
+                        self._process_fees_seq_nums(txs, ledger_delta)
+                    join_prewarm()
+                    tracer.end(sig_sp, pipelined=False)
+
+                # stage + dispatch the NEXT externalized txset's signature
+                # flush (and the overlay's pending SCP envelope batch)
+                # before apply starts: the verify runs on a worker while
+                # this ledger applies, and N+1's close joins it at its top
+                if pipe is not None:
+                    pipe.dispatch_ahead(tracer)
 
                 with tracer.span("close.apply", txs=len(txs)):
                     tx_result_set = TransactionResultSet([])

@@ -80,6 +80,12 @@ class Application:
         from ..invariant import InvariantManager
 
         self.invariants = InvariantManager(self)
+        # close-pipeline scheduler (ledger/closepipeline.py): overlaps the
+        # signature plane's verify for ledger N+1 with ledger N's apply —
+        # LedgerManager consults it only when Config.CLOSE_PIPELINE is on
+        from ..ledger.closepipeline import ClosePipeline
+
+        self.close_pipeline = ClosePipeline(self)
         self.bucket_manager = BucketManager(self)
         self.ledger_manager = LedgerManager(self)
         self.history_manager = HistoryManager(self)

@@ -232,6 +232,19 @@ class Config:
         # differential suite (tests/test_framecontext.py) runs both and
         # compares ledger hashes + SQL dumps + history metas.
         self.COW_ENTRY_SNAPSHOTS = True
+        # TPU-native addition: pipelined ledger close
+        # (ledger/closepipeline.py) — while txset N is in close.apply, the
+        # signature prewarm for the already-externalized txset N+1 (and
+        # pending SCP envelope batches) dispatches asynchronously through
+        # SigBackend.verify_batch_async; N+1's close joins the future at
+        # its top, so the device/host verify cost hides inside N's apply
+        # wall.  Off = reference-style serial phases; the differential
+        # suite (tests/test_framecontext.py, test_closepipeline.py) runs
+        # both and compares ledger hashes + SQL dumps + history metas.
+        self.CLOSE_PIPELINE = True
+        # how many upcoming txsets may hold an in-flight prewarm future at
+        # once (the lookahead window; 1 = classic two-stage pipeline)
+        self.CLOSE_PIPELINE_DEPTH = 2
 
     # -- loading -----------------------------------------------------------
     @classmethod
@@ -319,6 +332,14 @@ class Config:
             raise ValueError(
                 f"INVARIANT_CACHE_SAMPLE must be an int >= 1, "
                 f"got {self.INVARIANT_CACHE_SAMPLE!r}"
+            )
+        if not (
+            isinstance(self.CLOSE_PIPELINE_DEPTH, int)
+            and self.CLOSE_PIPELINE_DEPTH >= 1
+        ):
+            raise ValueError(
+                f"CLOSE_PIPELINE_DEPTH must be an int >= 1, "
+                f"got {self.CLOSE_PIPELINE_DEPTH!r}"
             )
 
     def to_short_string(self, pk: PublicKey) -> str:

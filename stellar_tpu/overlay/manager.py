@@ -188,6 +188,17 @@ class OverlayManager:
             self._scp_flush_posted = True
             self.app.clock.post(self._flush_scp_batch)
 
+    def pending_scp_triples(self) -> list:
+        """Verify triples for the envelopes queued for this crank's batch
+        flush — the close pipeline (ledger/closepipeline.py) dispatches
+        these asynchronously while a ledger applies, so the flush on the
+        next crank is all cache hits.  A stale prefetch is harmless: the
+        flush re-verifies anything the cache missed."""
+        herder = self.app.herder
+        if herder is None or not self._scp_batch:
+            return []
+        return [herder.envelope_verify_triple(env) for env in self._scp_batch]
+
     def _flush_scp_batch(self) -> None:
         batch, self._scp_batch = self._scp_batch, []
         self._scp_flush_posted = False
@@ -195,7 +206,11 @@ class OverlayManager:
             return
         herder = self.app.herder
         triples = [herder.envelope_verify_triple(env) for env in batch]
-        self.app.sig_backend.verify_batch(triples)
+        # own caller class: a wedge latch flipped by this crank-driven
+        # flush (or by a pipelined prewarm) stays scoped to its plane
+        from ..crypto.sigbackend import CALLER_OVERLAY
+
+        self.app.sig_backend.verify_batch(triples, caller=CALLER_OVERLAY)
         self.m_scp_batch_flush.mark()
         self.m_scp_batch_size.inc(len(batch))
         for env in batch:

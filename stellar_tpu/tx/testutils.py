@@ -190,9 +190,14 @@ def inflation_op(source=None) -> X.Operation:
 # -- apply helpers (TxTests applyCheck pattern) -----------------------------
 
 
-def close_ledger_on(app, close_time: int, txs=()) -> None:
+def close_ledger_on(app, close_time: int, txs=(), externalize: bool = False) -> None:
     """The reference's closeLedgerOn (TxTests.cpp): close one real ledger
-    at a chosen closeTime, optionally carrying transactions."""
+    at a chosen closeTime, optionally carrying transactions.
+
+    ``externalize=True`` drives ``LedgerManager.externalize_value`` instead
+    of closing inline — the path consensus takes, which routes through the
+    close-pipeline scheduler's enqueue/drain/join machinery when
+    ``Config.CLOSE_PIPELINE`` is on (ledger/closepipeline.py)."""
     from ..herder.ledgerclose import LedgerCloseData
     from ..herder.txset import TxSetFrame
     from ..xdr.ledger import StellarValue
@@ -201,7 +206,30 @@ def close_ledger_on(app, close_time: int, txs=()) -> None:
     txset = TxSetFrame(lm.last_closed.hash, list(txs))
     txset.sort_for_hash()
     sv = StellarValue(txset.get_contents_hash(), close_time, [], 0)
-    lm.close_ledger(LedgerCloseData(lm.current.header.ledgerSeq, txset, sv))
+    ld = LedgerCloseData(lm.current.header.ledgerSeq, txset, sv)
+    if externalize:
+        lm.externalize_value(ld)
+    else:
+        lm.close_ledger(ld)
+
+
+def dump_state(db) -> dict:
+    """Entry tables + the history planes (txmeta/txchanges columns carry
+    the XDR'd LedgerEntryChanges) — THE bit-exactness oracle shared by
+    every differential suite and A/B harness (frame-context / CoW /
+    close-pipeline).  Add new state tables HERE so every differential
+    keeps covering them."""
+    out = {}
+    for table, order in (
+        ("accounts", "accountid"),
+        ("signers", "accountid, publickey"),
+        ("trustlines", "accountid, issuer, assetcode"),
+        ("offers", "offerid"),
+        ("txhistory", "ledgerseq, txindex"),
+        ("txfeehistory", "ledgerseq, txindex"),
+    ):
+        out[table] = db.query_all(f"SELECT * FROM {table} ORDER BY {order}")
+    return out
 
 
 def test_date(day: int, month: int, year: int) -> int:

@@ -1,0 +1,482 @@
+"""Close-pipeline scheduler (ledger/closepipeline.py) and the async
+signature-flush surface (crypto/sigbackend.py verify_batch_async /
+SigFlushFuture).
+
+Three planes under test:
+
+1. the future itself — all-hit batches resolve from the cache without
+   touching the inner backend, misses latch into the shared verify cache
+   only AT COMPLETION, and ``quarantine()`` both blocks the pending latch
+   and evicts an already-performed one (in either completion order);
+2. the replay/backlog pipeline — an externalized-but-unclosed run of
+   ledgers closes bit-identically to the inline serial path (hashes + SQL
+   + history metas), with ledger N+1's signature verify genuinely joined
+   from a future dispatched during ledger N's close;
+3. the abort paths (ISSUE r10 satellite): an invariant-aborted close, a
+   catchup interrupt, and a backend raise must quarantine in-flight
+   futures — the cache never holds verdicts from a quarantined batch —
+   and the node must recover (retry clean / fall back to the inline
+   flush).  All differential legs run PARANOID with invariants all-on
+   (the standing aliasing-PR landing policy).
+"""
+
+import time
+
+import pytest
+
+import stellar_tpu.xdr as X
+from stellar_tpu.crypto.keys import SecretKey
+from stellar_tpu.crypto.sigbackend import (
+    CALLER_CLOSE,
+    CALLER_PIPELINE,
+    CachingSigBackend,
+    CpuSigBackend,
+    SigFlushFuture,
+)
+from stellar_tpu.crypto.sigcache import VerifySigCache
+from stellar_tpu.herder.ledgerclose import LedgerCloseData
+from stellar_tpu.herder.txset import TxSetFrame
+from stellar_tpu.invariant import InvariantViolation
+from stellar_tpu.invariant import testing as inj
+from stellar_tpu.main.application import Application
+from stellar_tpu.tx import testutils as T
+from stellar_tpu.tx.frame import TransactionFrame
+from stellar_tpu.util import VIRTUAL_TIME, VirtualClock
+from stellar_tpu.xdr.ledger import StellarValue
+
+RC = X.TransactionResultCode
+
+
+@pytest.fixture
+def clock():
+    c = VirtualClock(VIRTUAL_TIME)
+    yield c
+    c.shutdown()
+
+
+def _triples(n, tag=b"flush"):
+    out = []
+    for i in range(n):
+        sk = SecretKey.pseudo_random_for_testing(40_000_000 + i)
+        msg = tag + b" %d" % i
+        out.append((sk.public_raw, msg, sk.sign(msg)))
+    return out
+
+
+def _keys(cache, triples):
+    return [cache.key_for(pk, sig, msg) for pk, msg, sig in triples]
+
+
+class _SlowCpuBackend(CpuSigBackend):
+    """CpuSigBackend whose verify stalls until released — lets a test hold
+    a future in the in-flight state deterministically."""
+
+    def __init__(self):
+        import threading
+
+        self.release = threading.Event()
+
+    def verify_batch(self, items, caller=CALLER_CLOSE):
+        assert self.release.wait(10), "test never released the backend"
+        return super().verify_batch(items, caller=caller)
+
+
+class TestSigFlushFuture:
+    def _backend(self, inner=None):
+        cache = VerifySigCache()
+        return CachingSigBackend(inner or CpuSigBackend(), cache), cache
+
+    def test_async_matches_sync_and_latches_at_completion(self):
+        be, cache = self._backend()
+        items = _triples(8) + [(b"\x00" * 32, b"bad", b"\x00" * 64)]
+        fut = be.verify_batch_async(items)
+        got = fut.result(timeout=10)
+        assert got == be.verify_batch(items)
+        assert got[:8] == [True] * 8 and got[8] is False
+        # verdicts latched: a fresh sync batch is all cache hits (the
+        # inner backend is bypassed entirely)
+        assert cache.peek_many(_keys(cache, items)) == got
+
+    def test_all_hit_batch_never_reaches_inner_backend(self):
+        calls = []
+
+        class CountingCpu(CpuSigBackend):
+            def verify_batch(self, items, caller=CALLER_CLOSE):
+                calls.append(len(items))
+                return super().verify_batch(items, caller=caller)
+
+        be, cache = self._backend(CountingCpu())
+        items = _triples(4, tag=b"hit")
+        be.verify_batch(items)  # warm
+        assert calls == [4]
+        fut = be.verify_batch_async(items)
+        assert fut.result(timeout=10) == [True] * 4
+        assert calls == [4], "an all-hit batch must resolve from the cache"
+
+    def test_quarantine_before_completion_blocks_latch(self):
+        slow = _SlowCpuBackend()
+        be, cache = self._backend(slow)
+        items = _triples(4, tag=b"quar-early")
+        fut = be.verify_batch_async(items, caller=CALLER_PIPELINE)
+        assert not fut.done()
+        fut.quarantine()
+        slow.release.set()
+        assert fut._done.wait(10)
+        time.sleep(0.05)  # let the worker's _complete fully finish
+        assert cache.peek_many(_keys(cache, items)) == [None] * 4, (
+            "a quarantined batch latched verdicts into the cache"
+        )
+        with pytest.raises(RuntimeError, match="quarantined"):
+            fut.result(timeout=1)
+
+    def test_quarantine_after_completion_evicts(self):
+        be, cache = self._backend()
+        items = _triples(4, tag=b"quar-late")
+        fut = be.verify_batch_async(items, caller=CALLER_PIPELINE)
+        assert fut.result(timeout=10) == [True] * 4
+        assert cache.peek_many(_keys(cache, items)) == [True] * 4
+        fut.quarantine()
+        assert cache.peek_many(_keys(cache, items)) == [None] * 4, (
+            "quarantine must withdraw already-latched verdicts"
+        )
+
+    def test_worker_error_reraises_and_latches_nothing(self):
+        class Boom(RuntimeError):
+            pass
+
+        class BadBackend(CpuSigBackend):
+            def verify_batch(self, items, caller=CALLER_CLOSE):
+                raise Boom("injected")
+
+        be, cache = self._backend(BadBackend())
+        items = _triples(3, tag=b"err")
+        fut = be.verify_batch_async(items)
+        with pytest.raises(Boom):
+            fut.result(timeout=10)
+        assert len(cache) == 0
+
+
+# -- replay/backlog harness --------------------------------------------------
+
+
+def _mk_app(clock, instance, pipeline=True):
+    cfg = T.get_test_config(instance)
+    cfg.CLOSE_PIPELINE = pipeline
+    cfg.PARANOID_MODE = True  # audit every close; invariants all-on already
+    return Application(clock, cfg, new_db=True)
+
+
+_dump_state = T.dump_state  # the shared bit-exactness oracle
+
+
+def _seq(app, sk):
+    from stellar_tpu.ledger.accountframe import AccountFrame
+
+    return AccountFrame.load_account(
+        sk.get_public_key(), app.database
+    ).get_seq_num() + 1
+
+
+def _build_reference_chain(app, names=("cp-a", "cp-b"), rounds=3):
+    """Drive `rounds` payment closes inline on `app` (pipeline off) and
+    record the externalized chain: (ledger_seq, [envelope xdr], sv) per
+    close, with real previous-ledger-hash linkage for replay elsewhere."""
+    lm = app.ledger_manager
+    root = T.root_key_for(app)
+    a, b = (T.get_account(n) for n in names)
+    T.close_ledger_on(
+        app, lm.last_closed.header.scpValue.closeTime + 5,
+        [T.tx_from_ops(app, root, _seq(app, root), [
+            T.create_account_op(a, 10**12), T.create_account_op(b, 10**12),
+        ])],
+    )
+    chain = []
+    for j in range(rounds):
+        txs = [
+            T.tx_from_ops(app, a, _seq(app, a), [T.payment_op(b, 10**6 + j)]),
+            T.tx_from_ops(app, b, _seq(app, b), [T.payment_op(a, 10**5 + j)]),
+        ]
+        txset = TxSetFrame(lm.last_closed.hash, txs)
+        txset.sort_for_hash()
+        sv = StellarValue(
+            txset.get_contents_hash(),
+            lm.last_closed.header.scpValue.closeTime + 5,
+            [],
+            0,
+        )
+        chain.append((
+            lm.current.header.ledgerSeq,
+            lm.last_closed.hash,
+            [tx.env_xdr() for tx in txs],
+            sv,
+        ))
+        lm.close_ledger(
+            LedgerCloseData(lm.current.header.ledgerSeq, txset, sv)
+        )
+    return chain
+
+
+def _replay_lds(app, chain):
+    """Rebuild the recorded chain as fresh LedgerCloseData on `app` (new
+    TransactionFrames from the envelope bytes — no object sharing)."""
+    from stellar_tpu.xdr.txs import TransactionEnvelope
+
+    lds = []
+    for seq, prev_hash, env_xdrs, sv in chain:
+        txs = [
+            TransactionFrame.make_from_wire(
+                app.network_id, TransactionEnvelope.from_xdr(raw)
+            )
+            for raw in env_xdrs
+        ]
+        txset = TxSetFrame(prev_hash, txs)
+        txset.sort_for_hash()
+        assert txset.get_contents_hash() == sv.txSetHash
+        lds.append(LedgerCloseData(seq, txset, sv))
+    return lds
+
+
+def _setup_replay_pair(clock, base, rounds=3, pipeline=True):
+    """(ref_app, pipe_app, lds): ref drove the chain inline; pipe_app has
+    the same accounts created and the chain pending as LedgerCloseData."""
+    ref = _mk_app(clock, base, pipeline=False)
+    pipe_app = _mk_app(clock, base + 1, pipeline=pipeline)
+    names = (f"cp-{base}-a", f"cp-{base}-b")
+    chain = _build_reference_chain(ref, names=names, rounds=rounds)
+    # identical create-close on the replay app (same network id → same
+    # genesis → same chain prefix)
+    lm2 = pipe_app.ledger_manager
+    root = T.root_key_for(pipe_app)
+    a, b = (T.get_account(n) for n in names)
+    T.close_ledger_on(
+        pipe_app, lm2.last_closed.header.scpValue.closeTime + 5,
+        [T.tx_from_ops(pipe_app, root, _seq(pipe_app, root), [
+            T.create_account_op(a, 10**12), T.create_account_op(b, 10**12),
+        ])],
+    )
+    assert lm2.last_closed.hash == chain[0][1], (
+        "replay app diverged before the replay even started"
+    )
+    # the verify cache is process-global (keys.py gVerifySigCache shape):
+    # the reference chain's closes already latched every triple the replay
+    # will flush, which would turn the pipeline's futures into all-hit
+    # no-ops.  Clear it so the replay's prewarms are REAL misses — the
+    # overlap and quarantine assertions below test the worker path.
+    from stellar_tpu.crypto.keys import PubKeyUtils
+
+    PubKeyUtils.clear_verify_sig_cache()
+    return ref, pipe_app, _replay_lds(pipe_app, chain)
+
+
+def test_replay_backlog_is_bit_exact_and_overlaps(clock):
+    """The headline differential: a buffered externalized run replayed
+    through the pipeline (the catchup shape, LedgerManager.history_caught_up)
+    produces bit-identical hashes/SQL/metas to the inline serial close,
+    with at least one ledger's signature flush genuinely joined from a
+    future dispatched during the previous close."""
+    ref, app, lds = _setup_replay_pair(clock, 60, rounds=3)
+    try:
+        lm = app.ledger_manager
+        lm.syncing_ledgers.extend(lds)
+        lm.history_caught_up()  # enqueues the whole run, then drains
+        assert (
+            lm.last_closed.hash == ref.ledger_manager.last_closed.hash
+        ), "pipelined replay forked from the inline close"
+        assert _dump_state(app.database) == _dump_state(ref.database)
+        pipe = app.close_pipeline
+        assert pipe.queued_count() == 0
+        assert pipe.n_dispatched >= 2, "no lookahead flush was dispatched"
+        assert pipe.n_joined >= 2, "no close joined a pipelined flush"
+        assert pipe.n_quarantined == 0
+        for inv_app in (ref, app):
+            assert inv_app.invariants.total_violations == 0
+            assert inv_app.invariants.closes_checked > 0
+    finally:
+        ref.database.close()
+        app.database.close()
+
+
+def test_pipeline_off_knob_restores_inline_path(clock):
+    ref, app, lds = _setup_replay_pair(clock, 62, rounds=2, pipeline=False)
+    try:
+        lm = app.ledger_manager
+        assert lm._close_pipeline() is None
+        lm.syncing_ledgers.extend(lds)
+        lm.history_caught_up()
+        assert lm.last_closed.hash == ref.ledger_manager.last_closed.hash
+        assert app.close_pipeline.n_dispatched == 0
+    finally:
+        ref.database.close()
+        app.database.close()
+
+
+def test_invariant_abort_quarantines_inflight_and_retries_clean(clock):
+    """Abort path 1: an invariant violation aborts close N while N+1's
+    flush is in flight — the future quarantines, the cache never holds
+    N+1's verdicts, and a retry drain closes the whole run clean."""
+    ref, app, lds = _setup_replay_pair(clock, 64, rounds=2)
+    try:
+        lm = app.ledger_manager
+        pipe = app.close_pipeline
+        cache = app.sig_backend.cache
+        # arm a one-shot SQL corruption for the NEXT checked close (ld[0])
+        app.invariants.inject_once(inj.corrupt_sql_balance(4242))
+        for ld in lds:
+            pipe.enqueue(ld)
+        with pytest.raises(InvariantViolation):
+            pipe.drain(lm._close_externalized)
+        assert pipe.n_quarantined >= 1, "in-flight futures must quarantine"
+        assert not pipe._futures
+        # ld[1]'s verdicts must be absent from the shared cache — now, and
+        # after any straggling worker completes
+        n1_triples = [
+            (tx.get_source_id().value, tx.get_contents_hash(),
+             tx.envelope.signatures[0].signature)
+            for tx in lds[1].tx_set.transactions
+        ]
+        time.sleep(0.3)
+        assert cache.peek_many(_keys(cache, n1_triples)) == [None] * len(
+            n1_triples
+        ), "cache holds verdicts from a quarantined batch"
+        # the failed ledger went back to the queue head: a retry drain
+        # (injection was one-shot) closes the full run and matches ref
+        assert pipe.queued_count() == len(lds)
+        pipe.drain(lm._close_externalized)
+        assert lm.last_closed.hash == ref.ledger_manager.last_closed.hash
+        assert _dump_state(app.database) == _dump_state(ref.database)
+    finally:
+        ref.database.close()
+        app.database.close()
+
+
+def test_catchup_interrupt_quarantines_and_rebuffers(clock):
+    """Abort path 2: start_catchup with queued-but-unclosed ledgers and
+    in-flight futures — futures quarantine, the queue moves into
+    syncing_ledgers, and the cache is clean of the prewarmed verdicts."""
+    ref, app, lds = _setup_replay_pair(clock, 66, rounds=2)
+    try:
+        lm = app.ledger_manager
+        pipe = app.close_pipeline
+        cache = app.sig_backend.cache
+        for ld in lds:
+            pipe.enqueue(ld)
+        pipe.dispatch_ahead(app.tracer)  # futures for both queued sets
+        assert pipe._futures
+        prewarmed = [
+            (tx.get_source_id().value, tx.get_contents_hash(),
+             tx.envelope.signatures[0].signature)
+            for ld in lds
+            for tx in ld.tx_set.transactions
+        ]
+        # intercept the catchup FSM: only the interrupt plane is under test
+        app.history_manager.catchup_history = lambda mode=None: None
+        lm.start_catchup()
+        assert pipe.queued_count() == 0
+        assert not pipe._futures and pipe.n_quarantined >= 1
+        assert [ld.ledger_seq for ld in lm.syncing_ledgers] == [
+            ld.ledger_seq for ld in lds
+        ]
+        time.sleep(0.3)
+        assert cache.peek_many(_keys(cache, prewarmed)) == [None] * len(
+            prewarmed
+        ), "cache holds verdicts from a quarantined batch"
+        # the buffered run replays clean once catchup "finishes"
+        lm.history_caught_up()
+        assert lm.last_closed.hash == ref.ledger_manager.last_closed.hash
+    finally:
+        ref.database.close()
+        app.database.close()
+
+
+def test_backend_raise_falls_back_to_inline_flush(clock):
+    """Abort path 3: the async flush worker raises — the join quarantines
+    the future, falls back to the inline prewarm, and the close (and the
+    whole replay) still lands bit-exact."""
+    ref, app, lds = _setup_replay_pair(clock, 68, rounds=3)
+    try:
+
+        class Boom(RuntimeError):
+            pass
+
+        real_async = app.sig_backend.verify_batch_async
+
+        def flaky_async(items, caller=CALLER_PIPELINE):
+            if caller == CALLER_PIPELINE:
+                fut = SigFlushFuture(len(items))
+                fut._complete(err=Boom("injected async failure"))
+                return fut
+            return real_async(items, caller=caller)
+
+        app.sig_backend.verify_batch_async = flaky_async
+        lm = app.ledger_manager
+        lm.syncing_ledgers.extend(lds)
+        lm.history_caught_up()
+        pipe = app.close_pipeline
+        assert pipe.n_fallback >= 2, "failed futures must fall back inline"
+        assert pipe.n_joined == 0
+        assert lm.last_closed.hash == ref.ledger_manager.last_closed.hash
+        assert _dump_state(app.database) == _dump_state(ref.database)
+    finally:
+        ref.database.close()
+        app.database.close()
+
+
+def test_externalize_backlog_queues_instead_of_gap_catchup(clock):
+    """externalize_value with the pipeline on treats sequences just past
+    the queue tail as backlog (enqueue + drain), not as a gap — and a
+    reentrant externalize during a drain enqueues for the outer loop."""
+    ref, app, lds = _setup_replay_pair(clock, 70, rounds=2)
+    try:
+        lm = app.ledger_manager
+        for ld in lds:
+            lm.externalize_value(ld)  # drains immediately: queue stays 0-1
+        assert lm.last_closed.hash == ref.ledger_manager.last_closed.hash
+        assert app.close_pipeline.queued_count() == 0
+    finally:
+        ref.database.close()
+        app.database.close()
+
+
+def test_scp_envelope_prewarm_warms_flush(clock):
+    """dispatch_ahead verifies the overlay's pending SCP envelope batch on
+    a worker; the crank's flush then runs against a warm cache."""
+    cfg = T.get_test_config(71)
+    app = Application.create(clock, cfg, new_db=True)
+    try:
+        from stellar_tpu.xdr.scp import (
+            SCPBallot,
+            SCPEnvelope,
+            SCPStatement,
+            SCPStatementConfirm,
+            SCPStatementPledges,
+            SCPStatementType,
+        )
+
+        herder = app.herder
+        env = SCPEnvelope(
+            statement=SCPStatement(
+                nodeID=cfg.NODE_SEED.get_public_key(),
+                slotIndex=7,
+                pledges=SCPStatementPledges(
+                    SCPStatementType.SCP_ST_CONFIRM,
+                    SCPStatementConfirm(
+                        b"\x11" * 32, 1, SCPBallot(1, b"cp-scp-value"), 1
+                    ),
+                ),
+            ),
+            signature=b"",
+        )
+        # sign over the statement payload like emit_envelope does
+        herder.sign_envelope(env)
+        om = app.overlay_manager
+        om._scp_batch.append(env)
+        triples = om.pending_scp_triples()
+        assert len(triples) == 1
+        app.close_pipeline.dispatch_ahead(app.tracer)
+        assert app.close_pipeline._scp_futures
+        fut = app.close_pipeline._scp_futures[0]
+        assert fut.result(timeout=10) == [True]
+        cache = app.sig_backend.cache
+        assert cache.peek_many(_keys(cache, triples)) == [True]
+    finally:
+        app.graceful_stop()

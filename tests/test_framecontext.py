@@ -38,29 +38,21 @@ def clock():
     c.shutdown()
 
 
-def _dump_state(db):
-    """Entry tables + the history planes (txmeta/txchanges columns carry
-    the XDR'd LedgerEntryChanges — the delta-meta half of the contract)."""
-    out = {}
-    for table, order in (
-        ("accounts", "accountid"),
-        ("signers", "accountid, publickey"),
-        ("trustlines", "accountid, issuer, assetcode"),
-        ("offers", "offerid"),
-        ("txhistory", "ledgerseq, txindex"),
-        ("txfeehistory", "ledgerseq, txindex"),
-    ):
-        out[table] = db.query_all(f"SELECT * FROM {table} ORDER BY {order}")
-    return out
+_dump_state = T.dump_state  # the shared bit-exactness oracle (testutils)
 
 
 class _Runner:
     """Drive the same close sequence through two apps (`knob` on / off)
     and compare ledger hashes + SQL + history after every close."""
 
-    KNOBS = {"frame_context": "FRAME_CONTEXT", "cow": "COW_ENTRY_SNAPSHOTS"}
+    KNOBS = {
+        "frame_context": "FRAME_CONTEXT",
+        "cow": "COW_ENTRY_SNAPSHOTS",
+        "close_pipeline": "CLOSE_PIPELINE",
+    }
 
     def __init__(self, clock, instance_base, knob="frame_context"):
+        self.knob = knob
         self.apps = []
         for i, on in enumerate((True, False)):
             cfg = T.get_test_config(instance_base + i)
@@ -73,8 +65,13 @@ class _Runner:
         for app in self.apps:
             lm = app.ledger_manager
             txs = build_txs(app, T.root_key_for(app))
+            # the close_pipeline legs close via externalize_value so the
+            # pipeline-on app routes through the scheduler's enqueue/
+            # drain/join machinery (the consensus path), not the inline
+            # close the off-knob app takes
             T.close_ledger_on(
-                app, lm.last_closed.header.scpValue.closeTime + 5, txs
+                app, lm.last_closed.header.scpValue.closeTime + 5, txs,
+                externalize=(self.knob == "close_pipeline"),
             )
             results.append([tx.get_result_code() for tx in txs])
         fc_app, ref_app = self.apps
@@ -94,6 +91,11 @@ class _Runner:
             assert inv.total_violations == 0, inv.dump_info()
             assert inv.closes_checked > 0
             assert all(s["runs"] > 0 for s in inv.stats().values())
+        if self.knob == "close_pipeline":
+            # the scheduler must end every close drained and clean
+            pipe = fc_app.close_pipeline
+            assert pipe.queued_count() == 0
+            assert pipe.n_quarantined == 0
         return results[0]
 
     def shutdown(self):
@@ -101,13 +103,15 @@ class _Runner:
             app.database.close()
 
 
-@pytest.fixture(params=["frame_context", "cow"])
+@pytest.fixture(params=["frame_context", "cow", "close_pipeline"])
 def runner(clock, request):
-    """Every differential scenario runs twice: FRAME_CONTEXT on/off and
-    COW_ENTRY_SNAPSHOTS on/off (each vs an otherwise-default config) —
-    the two aliasing planes share one equivalence oracle."""
+    """Every differential scenario runs three times: FRAME_CONTEXT on/off,
+    COW_ENTRY_SNAPSHOTS on/off, and CLOSE_PIPELINE on/off (each vs an
+    otherwise-default config) — the aliasing planes and the pipelined
+    close share one equivalence oracle."""
     r = _Runner(
-        clock, {"frame_context": 72, "cow": 84}[request.param],
+        clock,
+        {"frame_context": 72, "cow": 84, "close_pipeline": 96}[request.param],
         knob=request.param,
     )
     yield r

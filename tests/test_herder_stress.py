@@ -188,9 +188,9 @@ def test_scp_envelopes_coalesce_into_one_sig_batch(clock):
     calls = []
     inner_verify = app.sig_backend.verify_batch
 
-    def counting_verify(triples):
+    def counting_verify(triples, **kw):
         calls.append(len(triples))
-        return inner_verify(triples)
+        return inner_verify(triples, **kw)
 
     app.sig_backend.verify_batch = counting_verify
     before_valid = h.m_envelope_validsig.count

@@ -44,11 +44,19 @@ def test_three_nodes_two_running():
     run_sim(sim, 3)
 
 
+@pytest.mark.slow
 def test_three_nodes_tpu_backend_externalize():
     """A full consensus round with every node on SIGNATURE_BACKEND=tpu
     (VERDICT r03 weak #4: the tpu backend exercised at node level, not just
     by the benchmark) — envelopes and txsets verify through BatchVerifier,
-    consensus externalizes, ledgers agree."""
+    consensus externalizes, ledgers agree.
+
+    slow (r10 budget triage): 109 s, dominated by per-node XLA-CPU kernel
+    compiles.  The cpu-backend three-node test above carries the consensus
+    oracle in tier-1, and the TpuSigBackend routing/cutover/wedge planes
+    have dedicated fast tests (test_crypto TestTpuBackendCutover,
+    test_tx's wedge-latch suite); the all-tpu node-level round runs in
+    slow/device sessions."""
     from stellar_tpu.tx.testutils import get_test_config
 
     keys = [SecretKey.pseudo_random_for_testing(i + 1) for i in range(3)]

@@ -719,17 +719,27 @@ def test_wedged_device_dispatch_falls_back_to_host_and_latches():
     verify_batch caller — SCP flushes run on the main crank and ledger
     close joins the prewarm.  The backend finishes on host within
     DEVICE_TIMEOUT, then LATCHES onto host so a persistent outage costs
-    one bounded stall per RETRY_INTERVAL, not one per batch."""
+    one bounded stall per RETRY_INTERVAL, not one per batch.
+
+    The latch is scoped PER CALLER CLASS (ISSUE r10): a stall observed by
+    the pipelined async prewarm must not silently route the synchronous
+    close-path batches onto host — each class probes (and latches) the
+    device independently, and flips are metered per class."""
     import threading
     import time as _time
 
-    from stellar_tpu.crypto.sigbackend import TpuSigBackend
+    from stellar_tpu.crypto.sigbackend import (
+        CALLER_CLOSE,
+        CALLER_PIPELINE,
+        TpuSigBackend,
+    )
 
     be = TpuSigBackend.__new__(TpuSigBackend)  # skip JAX verifier init
     be.cpu_cutover = 0
     be.n_cutover_items = 0
     be.n_wedge_fallback_items = 0
-    be._wedged_until = 0.0
+    be._wedged_until = {}
+    be.n_latch_flips = {}
     be._wedge_lock = threading.Lock()
     be.DEVICE_TIMEOUT = 0.2
 
@@ -746,19 +756,29 @@ def test_wedged_device_dispatch_falls_back_to_host_and_latches():
     msg = b"wedge"
     items = [(sk.public_raw, msg, sk.sign(msg))]
     t0 = _time.perf_counter()
-    assert be.verify_batch(items) == [True]  # host fallback, correct result
+    # a stalled PIPELINE prewarm latches the pipeline class...
+    assert be.verify_batch(items, caller=CALLER_PIPELINE) == [True]
     assert 0.2 <= _time.perf_counter() - t0 < 5
     assert WedgedVerifier.calls == 1
-    # latched: the next batch goes straight to host, no new device attempt
+    assert be.n_latch_flips == {CALLER_PIPELINE: 1}
+    # ...latched: the next pipeline batch goes straight to host
     t0 = _time.perf_counter()
-    assert be.verify_batch(items) == [True]
+    assert be.verify_batch(items, caller=CALLER_PIPELINE) == [True]
     assert _time.perf_counter() - t0 < 0.1
     assert WedgedVerifier.calls == 1
     assert be.n_wedge_fallback_items == 2
-    # after the latch expires the device is probed again (and re-latches)
-    be._wedged_until = 0.0
-    assert be.verify_batch(items) == [True]
+    # ...but the synchronous close-path class still probes the device
+    # (and latches ITSELF after its own observed stall)
+    assert be.verify_batch(items, caller=CALLER_CLOSE) == [True]
     assert WedgedVerifier.calls == 2
+    assert be.n_latch_flips == {CALLER_PIPELINE: 1, CALLER_CLOSE: 1}
+    assert be.verify_batch(items, caller=CALLER_CLOSE) == [True]
+    assert WedgedVerifier.calls == 2  # close class now latched too
+    # after the latch expires the device is probed again (and re-latches)
+    be._wedged_until = {}
+    assert be.verify_batch(items, caller=CALLER_PIPELINE) == [True]
+    assert WedgedVerifier.calls == 3
+    assert be.n_latch_flips[CALLER_PIPELINE] == 2
 
 
 def test_start_rejects_insane_quorum_set(clock):
