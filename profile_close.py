@@ -532,6 +532,19 @@ def assert_budget(budget_ms=2000.0, n_txs=5000, n_ledgers=3):
         f"{n_txs} txs — budget {budget_ms:.0f} ms: "
         f"{'OK' if ok else 'EXCEEDED'}"
     )
+    # the static-analysis plane is build/test-time ONLY: if the close path
+    # ever grows an import of stellar_tpu.analysis, its runtime cost is no
+    # longer zero and this gate stops certifying that claim
+    analysis_mods = [
+        m for m in sys.modules if m.startswith("stellar_tpu.analysis")
+    ]
+    if analysis_mods:
+        print(
+            "BUDGET GATE: stellar_tpu.analysis leaked into the close-path"
+            f" runtime ({analysis_mods}) — it must stay build/test-time only"
+        )
+        return 1
+    print("analysis plane: not imported by the close path (0 ms, by construction)")
     return 0 if ok else 1
 
 

@@ -105,6 +105,14 @@ FINAL_STEPS = [
      [sys.executable, "-u", "profile_close.py", "--pipeline-report",
       "5000", "3"],
      2400),
+    # r11: the static-analysis gate rides the certification checklist —
+    # relay-independent, but running it here pins every green-window
+    # measurement to a contract-clean tree (exit 1 = unsuppressed
+    # violations, 2 = a module failed to parse; both fail the step)
+    ("analysis_clean_r11",
+     [sys.executable, "-u", "-m", "stellar_tpu.analysis",
+      "stellar_tpu", "--json"],
+     300),
 ]
 ALL_NAMES = (
     [s[0] for s in SCRIPT_STEPS]

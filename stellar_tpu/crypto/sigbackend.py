@@ -63,19 +63,20 @@ class SigFlushFuture:
         self._lock = threading.Lock()
         self._result: Optional[List[bool]] = None
         self._err: Optional[BaseException] = None
-        self._quarantined = False
+        self._quarantined = False  # analysis: locked-by _lock
         # set by CachingSigBackend before dispatch: (cache, [(key, idx)...])
         # mapping miss keys to result rows — the latch happens inside
         # _complete under the future's lock so quarantine() can never race
         # a put_many it doesn't see
-        self._latch = None
-        self._latched = False
+        self._latch = None  # analysis: locked-by _lock
+        self._latched = False  # analysis: locked-by _lock
 
     def done(self) -> bool:
         return self._done.is_set()
 
     def quarantined(self) -> bool:
-        return self._quarantined
+        with self._lock:
+            return self._quarantined
 
     def quarantine(self) -> None:
         """Disown the batch: results will not (and no longer do) back the
@@ -223,6 +224,7 @@ class CachingSigBackend(SigBackend):
                     return
                 # plain attribute store is atomic; _complete reads it
                 # under fut._lock and skips the latch if a quarantine won
+                # analysis: off locked-field -- happens-before by program order on the worker: _latch is written before the inner verify_batch, and _complete (same thread, after it) is the only reader path — there is no concurrent writer to exclude
                 fut._latch = (self.cache, [(keys[i], i) for i in miss_idx])
                 fresh = self.inner.verify_batch(
                     [items[i] for i in miss_idx], caller=caller
@@ -384,7 +386,7 @@ class TpuSigBackend(SigBackend):
         # (caller="close") keep probing the device, and vice versa.  A
         # single shared latch silently routed every subsequent close flush
         # onto host for RETRY_INTERVAL after one stalled async prewarm.
-        self._wedged_until: dict = {}
+        self._wedged_until: dict = {}  # analysis: locked-by _wedge_lock
         self.n_latch_flips: dict = {}
         # verify_batch is called concurrently (async signature prewarm
         # worker + the SCP crank); the latch read/write and the budget

@@ -17,7 +17,7 @@ class VerifySigCache:
     def __init__(self, capacity: int = 0xFFFF):
         self.capacity = capacity
         self._lock = threading.Lock()
-        self._map: OrderedDict[bytes, bool] = OrderedDict()
+        self._map: OrderedDict[bytes, bool] = OrderedDict()  # analysis: locked-by _lock
         self._hits = 0
         self._misses = 0
 

@@ -52,6 +52,10 @@ RETRY_DELAY_SECONDS = 2.0
 
 
 class CatchupStateMachine:
+    # per-process construction counter feeding the archive-pick seed (see
+    # __init__): deterministic within a run, rotates across sessions
+    _nonce = 0
+
     def __init__(
         self,
         app,
@@ -77,6 +81,21 @@ class CatchupStateMachine:
         self.headers: Dict[int, LedgerHeaderHistoryEntry] = {}
         self.tx_sets: Dict[int, object] = {}
         self._timer = VirtualTimer(app.clock)
+        # archive spread is load-balancing; seed the pick from the node's
+        # identity XOR a per-process construction nonce so a catchup run
+        # replays identically (same construction order => same picks)
+        # while successive catchup sessions — and distinct nodes — still
+        # rotate across archives instead of pinning one forever
+        # (determinism rule — module-level random would diverge two
+        # otherwise-equal runs)
+        seed = getattr(app.config, "NODE_SEED", None)
+        ident = (
+            int.from_bytes(seed.get_public_key().value[:8], "big")
+            if seed is not None
+            else 0xCA7C4
+        )
+        CatchupStateMachine._nonce += 1
+        self._rng = random.Random(ident ^ (CatchupStateMachine._nonce << 16))
 
     # -- BEGIN: pick archive, fetch root state -----------------------------
     def begin(self) -> None:
@@ -90,7 +109,7 @@ class CatchupStateMachine:
             log.error("catchup: no readable history archives configured")
             self._fail()
             return
-        self.archive = random.choice(readable)
+        self.archive = self._rng.choice(readable)
         local = os.path.join(self.tmp.get_name(), "remote-state.json")
 
         def got(rc):

@@ -135,7 +135,7 @@ class AccountFrame(EntryFrame):
         validation (AccountFrame::makeAuthOnlyAccount): negative balance trips
         any attempt to persist it (the accounts CHECK constraint)."""
         f = cls(account_id=account_id)
-        f.account.balance = -0x8000000000000000
+        f.mut().balance = -0x8000000000000000
         return f
 
     @staticmethod

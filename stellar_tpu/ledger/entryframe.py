@@ -165,6 +165,7 @@ class EntryFrame:
                 # the sealed snapshot can be re-shared without a copy
                 return
             self.touch()
+        # analysis: off cow-mutation -- this setter IS the CoW machinery: the seal branch above either proved the stamp a no-op or paid the touch() copy
         self.entry.lastModifiedLedgerSeq = seq
 
     def copy(self) -> "EntryFrame":
@@ -202,6 +203,16 @@ class EntryFrame:
         if self._sealed:
             self.touch()
         return self.entry.data.value
+
+    def replace_body(self, body) -> None:
+        """Swap the typed entry body wholesale (ManageOffer's update path
+        rebuilds the OfferEntry rather than patching fields).  CoW-unseals
+        first so the swap can never reach a snapshot already shared with
+        the delta/cache/store-buffer, then re-points the typed alias."""
+        self.touch()
+        # analysis: off cow-mutation -- the one sanctioned body-swap site: touch() above paid the CoW copy and _rebind_entry below re-points the alias
+        self.entry.data.value = body
+        self._rebind_entry()
 
     # -- store interface ---------------------------------------------------
     def _assert_mutable(self) -> None:

@@ -95,7 +95,7 @@ class LedgerManager:
         """Genesis: master account funded with all coins, ledger 1."""
         skey = SecretKey.from_seed(self.app.network_id)
         master = AccountFrame(account_id=skey.get_public_key())
-        master.account.balance = GENESIS_BALANCE
+        master.mut().balance = GENESIS_BALANCE
 
         genesis = LedgerHeader(
             ledgerVersion=0,

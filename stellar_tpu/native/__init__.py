@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import ctypes
 import os
+import re
 import subprocess
 import threading
 from typing import List, Optional, Sequence, Tuple
@@ -29,6 +30,66 @@ _lib = None
 _tried = False
 
 
+# -- sanitizer build mode ----------------------------------------------------
+#
+# STELLAR_TPU_SANITIZE=<list> (e.g. "address,undefined") rebuilds every
+# extension with -fsanitize=<list> into a SEPARATE "<name>.san.so" artifact
+# (the normal .so is never clobbered) — the test-only build mode the
+# ASan+UBSan differential leg drives (tests/test_native_build.py).  A
+# sanitized CPython extension only loads into an interpreter with the
+# sanitizer runtime present, so the leg runs its driver in a subprocess
+# with LD_PRELOAD set from sanitizer_preload_libs().
+
+
+def sanitize_mode() -> Optional[str]:
+    return os.environ.get("STELLAR_TPU_SANITIZE") or None
+
+
+def _san_flags() -> tuple:
+    mode = sanitize_mode()
+    if not mode:
+        return ()
+    return (f"-fsanitize={mode}", "-fno-sanitize-recover=all", "-g", "-O1")
+
+
+def _san_so(so: str) -> str:
+    """Artifact name encodes the EXACT sanitize set (mtime-based staleness
+    alone would silently reuse an address-only build for an
+    address,undefined run)."""
+    mode = sanitize_mode()
+    if not mode:
+        return so
+    slug = re.sub(r"[^A-Za-z0-9]+", "-", mode).strip("-")
+    return f"{so[:-3]}.san-{slug}.so"
+
+
+def sanitizer_preload_libs(kinds: Sequence[str] = ("asan", "ubsan")) -> Optional[List[str]]:
+    """Resolved shared-runtime paths to LD_PRELOAD for a subprocess that
+    loads sanitized extensions, or None when the toolchain can't name them
+    (clang's static runtimes, no toolchain at all)."""
+    out = []
+    for kind in kinds:
+        path = None
+        for cc in ("cc", "gcc", "clang"):
+            try:
+                r = subprocess.run(
+                    [cc, f"-print-file-name=lib{kind}.so"],
+                    capture_output=True,
+                    timeout=30,
+                    text=True,
+                )
+            except (OSError, subprocess.TimeoutExpired):
+                continue
+            cand = r.stdout.strip()
+            if r.returncode == 0 and os.sep in cand and os.path.exists(cand):
+                path = cand
+                break
+        if path is None:
+            return None
+        out.append(path)
+    return out
+
+
 def _compile_so(src: str, so: str, extra_flags: Sequence[str] = ()) -> bool:
     # per-process temp name: concurrent first-use builds in sibling
     # processes must not interleave writes into one file
@@ -36,7 +97,8 @@ def _compile_so(src: str, so: str, extra_flags: Sequence[str] = ()) -> bool:
     for cc in ("cc", "gcc", "clang"):
         try:
             r = subprocess.run(
-                [cc, "-O2", "-shared", "-fPIC", *extra_flags, "-o", tmp, src],
+                [cc, "-O2", "-shared", "-fPIC", *_san_flags(), *extra_flags,
+                 "-o", tmp, src],
                 capture_output=True,
                 timeout=120,
             )
@@ -64,7 +126,7 @@ def _needs_build(src: str, so: str) -> bool:
 
 
 def _build() -> bool:
-    return _compile_so(_SRC, _SO)
+    return _compile_so(_SRC, _san_so(_SO))
 
 
 def _load():
@@ -73,11 +135,12 @@ def _load():
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if _needs_build(_SRC, _SO):
+        so = _san_so(_SO)
+        if _needs_build(_SRC, so):
             if not _build():
                 return None
         try:
-            lib = ctypes.CDLL(_SO)
+            lib = ctypes.CDLL(so)
         except OSError:
             return None
         lib.bucket_merge.restype = ctypes.c_int
@@ -189,7 +252,7 @@ def load_cxdrpack():
         if _cxdr_mod is not None or _cxdr_tried:
             return _cxdr_mod
         _cxdr_tried = True
-        _cxdr_mod = _load_extension("_cxdrpack", _CXDR_SRC, _CXDR_SO)
+        _cxdr_mod = _load_extension("_cxdrpack", _CXDR_SRC, _san_so(_CXDR_SO))
         return _cxdr_mod
 
 
@@ -214,6 +277,6 @@ def load_sighash():
             return _sighash_mod
         _sighash_tried = True
         _sighash_mod = _load_extension(
-            "_sighash", _SIGHASH_SRC, _SIGHASH_SO, ("-pthread",)
+            "_sighash", _SIGHASH_SRC, _san_so(_SIGHASH_SO), ("-pthread",)
         )
         return _sighash_mod

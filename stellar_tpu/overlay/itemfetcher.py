@@ -28,6 +28,12 @@ class Tracker:
         self.ask_peer = ask_peer  # fn(peer, hash) -> sends the GET_* message
         self.last_asked_peer = None
         self.peers_asked: List[object] = []
+        # peer pick order is load-balancing, not security: seed it from the
+        # item hash so a fetch sequence replays identically run-to-run
+        # (VirtualClock determinism discipline — analyzer rule
+        # `determinism`; the reference's gRandomEngine is likewise
+        # deterministically seeded under test)
+        self._rng = random.Random(int.from_bytes(item_hash[:8], "big"))
         self.timer = VirtualTimer(app.clock)
         self.envelopes: List[SCPEnvelope] = []
         self.num_list_rebuild = 0
@@ -83,12 +89,12 @@ class Tracker:
                 candidate = p
                 break
         if candidate is None and fresh:
-            candidate = random.choice(fresh)
+            candidate = self._rng.choice(fresh)
         if candidate is None:
             # exhausted everyone: rebuild the ask list and start over
             self.peers_asked.clear()
             self.num_list_rebuild += 1
-            candidate = random.choice(peers)
+            candidate = self._rng.choice(peers)
         self.peers_asked.append(candidate)
         self.last_asked_peer = candidate
         self.ask_peer(candidate, self.item_hash)

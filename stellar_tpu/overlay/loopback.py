@@ -25,6 +25,10 @@ MAX_QUEUE_DEPTH = 1000
 
 
 class LoopbackPeer(Peer):
+    # per-process construction counter feeding the fault-roll seed (see
+    # __init__): same construction order => same rolls, pairs uncorrelated
+    _ctor_nonce = 0
+
     def __init__(self, app, role: str):
         super().__init__(app, role)
         self.remote: Optional["LoopbackPeer"] = None
@@ -38,7 +42,19 @@ class LoopbackPeer(Peer):
         self.reorder_prob = 0.0
         self.damage_cert = False
         self.damage_auth = False
-        self._rng = random.Random()
+        # seeded: fault-injection rolls (drop/damage/reorder) must replay
+        # identically so a chaos run that found a bug can be re-run
+        # (determinism rule; probabilities default 0.0, so the seed is
+        # inert outside fault-injection tests).  Role bit + per-process
+        # construction nonce: the two sides of a pair AND distinct pairs
+        # in one topology all roll independent sequences, while the same
+        # construction order replays the same faults run-to-run.
+        LoopbackPeer._ctor_nonce += 1
+        self._rng = random.Random(
+            0x100BBAC0
+            ^ (1 if role == PeerRole.WE_CALLED_REMOTE else 2)
+            ^ (LoopbackPeer._ctor_nonce << 8)
+        )
         self._closed = False
 
     # -- transport ----------------------------------------------------------

@@ -416,6 +416,7 @@ class Peer:
                     ip,
                     addr.port,
                     self.app.clock.now()
+                    # analysis: off determinism -- anti-stampede jitter over LEARNED peer addresses: spreading dials across the backoff window is the point, and the jitter never feeds consensus (PR 1 review added it deliberately)
                     + random.uniform(0.0, SECONDS_PER_BACKOFF),
                     0,
                 )

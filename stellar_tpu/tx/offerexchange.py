@@ -49,10 +49,11 @@ class OfferExchange:
         max_sheep_send: int,
     ):
         """-> (CrossOfferResult, num_wheat_received, num_sheep_send)."""
-        # load_best_offers frames are always freshly decoded/copied (never
-        # sealed), so this binding may be mutated in place until the
-        # store below seals it; nothing touches `offer` after that store
-        offer = selling_wheat_offer.offer
+        # mut(), not the read alias: this binding is mutated in place
+        # (amount shrink below) until the store seals it — mut() keeps
+        # that legal even if a future path hands us a sealed frame
+        # (load_best_offers frames are freshly decoded today)
+        offer = selling_wheat_offer.mut()
         sheep = offer.buying
         wheat = offer.selling
         account_b_id = offer.sellerID

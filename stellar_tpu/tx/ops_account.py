@@ -107,8 +107,9 @@ class CreateAccountOpFrame(OperationFrame):
         self.source_account.store_change(delta, db)
         dest = AccountFrame(account_id=self.ca.destination)
         # new accounts start at (currentLedgerSeq << 32)
-        dest.account.seqNum = delta.header_ro().ledgerSeq << 32
-        dest.account.balance = self.ca.startingBalance
+        body = dest.mut()
+        body.seqNum = delta.header_ro().ledgerSeq << 32
+        body.balance = self.ca.startingBalance
         dest.store_add(delta, db)
         metrics.new_meter(("op-create-account", "success", "apply"), "operation").mark()
         self.set_inner_result(

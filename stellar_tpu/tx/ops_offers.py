@@ -144,10 +144,9 @@ class ManageOfferOpFrame(OperationFrame):
                     metrics, "not-found", ManageOfferResultCode.MANAGE_OFFER_NOT_FOUND
                 )
             old_flags = sell_offer.offer.flags
-            sell_offer.entry.data.value = self._build_offer(
-                self.get_source_id(), mo, old_flags
+            sell_offer.replace_body(
+                self._build_offer(self.get_source_id(), mo, old_flags)
             )
-            sell_offer.offer = sell_offer.entry.data.value
             self.passive = bool(old_flags & OfferEntryFlags.PASSIVE_FLAG)
         else:
             flags = int(OfferEntryFlags.PASSIVE_FLAG) if self.passive else 0
@@ -269,6 +268,7 @@ class ManageOfferOpFrame(OperationFrame):
                             ManageOfferEffect.MANAGE_OFFER_UPDATED, None
                         )
                         sell_offer.store_change(temp_delta, db)
+                    # analysis: off cow-mutation -- `success` is the ManageOfferSuccessResult XDR union (a tx result, not an EntryFrame); `.offer` here is its effect arm, not an entry alias
                     success.offer.value = sell_offer.offer
                 else:
                     success.offer = ManageOfferSuccessResultOffer(

@@ -1,0 +1,22 @@
+# analysis-fixture-path: crypto/backend_fixture.py
+# NEGATIVE: writes inside the latch classes are the sanctioned completion
+# paths, and read-side calls (get/peek_many) are always free.
+from stellar_tpu.crypto.sigcache import VerifySigCache  # noqa: F401
+
+
+class CachingSigBackend:
+    def verify_batch(self, items):
+        self.cache.put_many((k, True) for k in items)
+
+
+class SigFlushFuture:
+    def quarantine(self):
+        self.cache.drop_many(self.keys)
+
+
+def read_only(cache, keys):
+    return cache.peek_many(keys)
+
+
+def unrelated_put(work_queue, item):
+    work_queue.put(item)  # a queue, not a verify cache — out of scope

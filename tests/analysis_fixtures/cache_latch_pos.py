@@ -1,0 +1,14 @@
+# analysis-fixture-path: overlay/sneaky_fixture.py
+# POSITIVE: verify-cache writes outside the latch classes bypass the
+# quarantine contract (the module references verify_cache, so the rule
+# engages).
+from stellar_tpu.crypto.keys import verify_cache
+
+
+def sneak_verdicts(key, pairs):
+    verify_cache().put(key, True)
+    verify_cache().put_many(pairs)
+
+
+def sneak_evict(keys):
+    verify_cache().drop_many(keys)

@@ -1,0 +1,23 @@
+# analysis-fixture-path: tx/ops_fixture.py
+# NEGATIVE: the sanctioned idioms — mut()/touch() routing, mut()-result
+# locals, alias REBINDS, and plain reads — must all pass clean.
+
+
+def apply(frame, fee):
+    frame.mut().balance -= fee          # the canonical write idiom
+    body = frame.mut()                  # mut()-result local ...
+    body.seqNum = 1                     # ... mutated directly: fine
+    frame.touch().entry = None          # touch() routing
+    return frame.account.balance        # reads through the alias are free
+
+
+class FixtureFrame:
+    def __init__(self, entry):
+        self.entry = entry              # alias REBIND, not a field write
+        self.account = entry            # same
+
+    def _rebind_entry(self):
+        self.account = self.entry.data.value
+
+    def touch(self):
+        self.entry.lastModified = 0     # inside the CoW machinery itself

@@ -1,0 +1,13 @@
+# analysis-fixture-path: tx/ops_fixture.py
+# POSITIVE: every statement below must flag cow-mutation (writes through an
+# EntryFrame typed alias without mut()/touch()).
+
+
+def apply(frame, dest, fee, s):
+    frame.account.balance -= fee            # aug-assign through alias
+    dest.entry.data.value = None            # body swap through .entry
+    frame.account.signers.append(object())  # in-place container mutator
+    frame.trust_line.limit = 10             # plain assign through alias
+    frame.account.signers[0] = s            # subscript write
+    frame.entry.data.value.signers[:] = []  # slice write
+    del frame.account.signers[1]            # subscript delete

@@ -1,0 +1,13 @@
+# analysis-fixture-path: scp/timing_fixture.py
+# NEGATIVE: VirtualClock time, seeded generators, and monotonic DURATION
+# stamps (telemetry) are all sanctioned.
+import random
+import time
+
+
+def ballot_timeout(app, peers, slot_index):
+    deadline = app.clock.now() + 5.0        # VirtualClock
+    rng = random.Random(slot_index)         # seeded generator
+    t0 = time.perf_counter()                # duration telemetry
+    dt = time.monotonic() - t0              # duration telemetry
+    return deadline, rng.choice(peers), dt

@@ -1,0 +1,17 @@
+# analysis-fixture-path: scp/timing_fixture.py
+# POSITIVE: wall-clock reads and module-level randomness in consensus
+# code — attribute-chain AND from-import spellings.
+import random
+import time
+from datetime import datetime
+from random import choice
+from time import time as wall_time
+
+
+def ballot_timeout(peers):
+    deadline = time.time() + 5.0            # wall clock
+    stamp = datetime.now()                  # wall clock
+    rng = random.Random()                   # UNSEEDED generator
+    also = wall_time()                      # from-imported time.time
+    pick = choice(peers)                    # from-imported random.choice
+    return deadline, stamp, rng, also, pick, random.choice(peers)

@@ -1,0 +1,26 @@
+/* analysis-fixture-path: native/fixture.c */
+/* NEGATIVE: borrow everything first, release, do pure C work, re-acquire;
+ * commented-out and string-literal "calls" must not fool the scanner. */
+#include <Python.h>
+
+static PyObject *
+good_worker(PyObject *self, PyObject *args)
+{
+    Py_buffer buf;
+    long total = 0;
+    if (!PyArg_ParseTuple(args, "y*", &buf))
+        return NULL;
+    Py_BEGIN_ALLOW_THREADS
+    /* PyErr_SetString(PyExc_ValueError, "only a comment"); */
+    total = do_pure_c_work((const char *)buf.buf, "Py_INCREF in a string");
+    if (total < 0) {
+        /* the sanctioned re-acquire shape: CPython API is legal between
+         * BLOCK and UNBLOCK because the GIL is held again */
+        Py_BLOCK_THREADS
+        PyErr_SetString(PyExc_ValueError, "negative total");
+        Py_UNBLOCK_THREADS
+    }
+    Py_END_ALLOW_THREADS
+    PyBuffer_Release(&buf);
+    return PyLong_FromLong(total);
+}

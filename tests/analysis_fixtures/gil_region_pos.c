@@ -1,0 +1,14 @@
+/* analysis-fixture-path: native/fixture.c */
+/* POSITIVE: CPython API calls inside a GIL-released region. */
+#include <Python.h>
+
+static PyObject *
+bad_worker(PyObject *self, PyObject *args)
+{
+    long total = 0;
+    Py_BEGIN_ALLOW_THREADS
+    total += PyLong_AsLong(args);              /* refuses the GIL contract */
+    PyErr_SetString(PyExc_ValueError, "boom"); /* so does this */
+    Py_END_ALLOW_THREADS
+    return PyLong_FromLong(total);
+}

@@ -1,0 +1,18 @@
+# analysis-fixture-path: crypto/future_fixture.py
+# NEGATIVE: declaration in __init__, and every later access under the
+# registered lock (including via another object of the same shape).
+import threading
+
+
+class Future:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state = None  # analysis: locked-by _lock
+
+    def poke(self):
+        with self._lock:
+            self._state = 1
+
+    def merge(self, other):
+        with other._lock:
+            return other._state

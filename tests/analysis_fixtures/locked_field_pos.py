@@ -1,0 +1,20 @@
+# analysis-fixture-path: crypto/future_fixture.py
+# POSITIVE: a locked-by registered field touched outside `with <lock>`.
+import threading
+
+
+class Future:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._wedge_lock = threading.Lock()
+        self._state = None  # analysis: locked-by _lock
+
+    def poke(self):
+        self._state = 1            # write without the lock
+
+    def peek(self):
+        return self._state         # read without the lock
+
+    def wrong_lock(self):
+        with self._wedge_lock:     # a DIFFERENT lock must not pass
+            return self._state

@@ -1,0 +1,12 @@
+# analysis-fixture-path: ledger/close_fixture.py
+# NEGATIVE: registry-built metrics ride the fast lane; marks/updates are
+# the sanctioned hot-path calls, and non-metric to_json stays untouched.
+
+
+def close_ledger(app, delta):
+    timer = app.metrics.new_timer(("ledger", "ledger", "close"))
+    meter = app.metrics.new_meter(("ledger", "transaction", "apply"), "tx")
+    with timer.time_scope():
+        meter.mark()
+    delta._apply(app)           # a delta's own _apply, not a metric drain
+    return delta.to_json()      # a delta, not a metric — out of scope

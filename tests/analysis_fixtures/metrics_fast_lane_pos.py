@@ -1,0 +1,13 @@
+# analysis-fixture-path: ledger/close_fixture.py
+# POSITIVE: lane-less metric construction and inline drains on the close
+# path.
+from stellar_tpu.util.metrics import Histogram, Meter, Timer
+
+
+def close_ledger(app):
+    t = Timer()                              # lane-less: slow path per call
+    m = Meter("event")                       # lane-less
+    h = Histogram()                          # lane-less
+    snapshot = app.metrics.to_json()         # inline drain + percentile sort
+    t.histogram._apply(1.0)                  # lane bypass
+    return m, h, snapshot

@@ -1,0 +1,11 @@
+# analysis-fixture-path: scp/suppress_fixture.py
+# NEGATIVE: a rationale-carrying suppression silences exactly its rule,
+# trailing-comment and own-line placements both.
+import time
+
+
+def sanctioned(xs):
+    # analysis: off determinism -- harness-only stopwatch around a crank loop; never feeds a consensus decision
+    a = time.time()
+    b = time.time()  # analysis: off determinism -- same stopwatch, trailing-comment placement
+    return a, b

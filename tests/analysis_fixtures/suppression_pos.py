@@ -1,0 +1,11 @@
+# analysis-fixture-path: scp/suppress_fixture.py
+# POSITIVE: a bare suppression (no rationale) and an unknown-rule
+# suppression are themselves violations, and the bare one does NOT
+# suppress the underlying hit.
+import time
+
+
+def bad(xs):
+    a = time.time()  # analysis: off determinism
+    b = 1  # analysis: off no-such-rule -- rationale for a rule that does not exist
+    return a, b

@@ -1,0 +1,10 @@
+# analysis-fixture-path: overlay/ingest_fixture.py
+# NEGATIVE: the ingest plane fully decodes untrusted bytes — that is the
+# sanctioned (validating) path; and the same accessor OUTSIDE the scoped
+# ingest modules is the trusted plane's business (see the herder fixture
+# path in the test).
+
+
+def ingest(raw, envelope_cls):
+    env = envelope_cls.from_xdr(raw)  # FULL decode, deliberately
+    return env.statement.slotIndex

@@ -1,0 +1,12 @@
+# analysis-fixture-path: overlay/ingest_fixture.py
+# POSITIVE: raw-XDR hot-field accessors in the pre-verify ingest plane.
+
+
+def peek_slot(raw, cxdrpack, prog):
+    a = xdr_getfield(object, raw, "statement.slotIndex")  # noqa: F821
+    b = cxdrpack.getfield(prog, raw, ("statement", "slotIndex"))
+    return a, b
+
+
+def patch_slot(raw):
+    xdr_setfield(object, raw, "statement.slotIndex", 7)  # noqa: F821

@@ -74,6 +74,166 @@ def test_cxdrpack_cold_build_pack_differential(cold_dir):
         assert cold.unpack(prog, want).to_xdr() == want
 
 
+def _sanitizer_ready():
+    """(preload_libs, reason_if_not): the ASan+UBSan leg needs a toolchain
+    that links -fsanitize=address,undefined AND names its shared runtimes
+    (LD_PRELOAD for the driver subprocess — a sanitized CPython extension
+    cannot load into an unsanitized interpreter otherwise)."""
+    libs = native.sanitizer_preload_libs()
+    if libs is None:
+        return None, "toolchain does not expose libasan/libubsan shared runtimes"
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        src = os.path.join(d, "probe.c")
+        with open(src, "w") as f:
+            f.write("int probe(int x) { return x + 1; }\n")
+        ok = native._compile_so(
+            src,
+            os.path.join(d, "probe.so"),
+            ("-fsanitize=address,undefined",),
+        )
+    if not ok:
+        return None, "cc cannot link -fsanitize=address,undefined"
+    return libs, None
+
+
+_SAN_DRIVER = r"""
+import hashlib, os, sys, tempfile
+import stellar_tpu.native as native
+
+assert native.sanitize_mode() == "address,undefined"
+
+# -- bucketmerge: sha256 differential --------------------------------------
+data = b"sanitizer parity \x00\xff" * 700
+with tempfile.NamedTemporaryFile(delete=False) as f:
+    f.write(data)
+try:
+    got = native.sha256_file(f.name)
+    assert got is not None, "bucketmerge failed to build sanitized"
+    assert got == hashlib.sha256(data).digest()
+finally:
+    os.unlink(f.name)
+
+# -- cxdrpack: pack/unpack + hostile/truncated inputs ----------------------
+import random
+from stellar_tpu.xdr.arbitrary import arbitrary_of
+from stellar_tpu.xdr.base import XdrError, _cspec_of
+from stellar_tpu.xdr.entries import LedgerEntry
+
+mod = native.load_cxdrpack()
+assert mod is not None, "cxdrpack failed to build sanitized"
+defs = []
+root = _cspec_of(LedgerEntry._codec, defs, {})
+prog = mod.compile(defs, root, XdrError)
+for i in range(25):
+    v = arbitrary_of(LedgerEntry, 8, random.Random(i))
+    octets = mod.pack(prog, v)
+    assert mod.unpack(prog, octets).to_xdr() == octets
+    # truncated tails must raise, not read out of bounds (ASan's job)
+    for cut in (1, 4, len(octets) // 2):
+        try:
+            mod.unpack(prog, octets[: len(octets) - cut])
+        except XdrError:
+            pass
+    # hostile garbage
+    try:
+        mod.unpack(prog, b"\xff" * 64)
+    except XdrError:
+        pass
+
+# -- sighash: stage differential incl. hostile/truncated items -------------
+sig_mod = native.load_sighash()
+assert sig_mod is not None, "sighash failed to build sanitized"
+from stellar_tpu.ops import ref25519 as ref
+
+bl = b"".join(ref.small_order_blacklist())
+# item 0 is crafted to PASS the host gate (canonical pk < p, canonical
+# s < L, non-blacklisted) so the hashlib differential below always has an
+# accepted lane; the rest are hostile randoms
+items = [(b"\x42" + b"\x24" * 31, b"known msg",
+          b"\x99" * 32 + b"\x01" + b"\x00" * 31)]
+rng = random.Random(1234)
+for i in range(63):
+    pk = bytes(rng.randrange(256) for _ in range(32))
+    msg = bytes(rng.randrange(256) for _ in range(rng.randrange(1, 200)))
+    sig = bytes(rng.randrange(256) for _ in range(64))
+    if i % 5 == 0:
+        sig = sig[:32] + b"\xff" * 32  # hostile non-canonical s
+    items.append((pk, msg, sig))
+out = bytearray(128 * 64)
+ok = bytearray(64)
+rejects = sig_mod.stage(items, 0, 64, out, ok, bl)
+assert 0 <= rejects < 64 and ok[0] == 1
+# differential vs hashlib for one accepted lane
+for lane, (pk, msg, sig) in enumerate(items):
+    if ok[lane]:
+        h = int.from_bytes(hashlib.sha512(sig[:32] + pk + msg).digest(),
+                           "little") % ref.L
+        assert bytes(out[96 * 64 + lane : : 64][:32]) == h.to_bytes(32, "little")
+        break
+# truncated input rows must raise cleanly, never scribble
+try:
+    sig_mod.stage([(b"short", b"m", b"s")], 0, 1, bytearray(128), bytearray(1), bl)
+except (ValueError, TypeError):
+    pass
+
+# -- sodium pool leg (skipped silently when libsodium is absent) -----------
+try:
+    from stellar_tpu.crypto import sodium
+
+    fn = sodium.verify_fn_addr()
+except Exception:
+    fn = None
+if fn is not None and hasattr(sig_mod, "sodium_verify"):
+    okb = bytearray(len(items))
+    sig_mod.sodium_verify(fn, items, okb)
+    assert set(okb) <= {0, 1}
+
+print("SAN_OK")
+"""
+
+
+@pytest.mark.slow
+def test_sanitized_build_differentials():
+    """ASan+UBSan leg: rebuild all three extensions with
+    -fsanitize=address,undefined (the STELLAR_TPU_SANITIZE plumb-through,
+    separate .san.so artifacts) and run the hostile/truncated-input
+    differentials inside a driver subprocess with the sanitizer runtimes
+    preloaded.  Any out-of-bounds read/UB the normal suite can't see
+    aborts the driver and fails here."""
+    libs, reason = _sanitizer_ready()
+    if libs is None:
+        pytest.skip(reason)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.update(
+        STELLAR_TPU_SANITIZE="address,undefined",
+        LD_PRELOAD=":".join(libs),
+        # leak accounting is meaningless for a short-lived driver and noisy
+        # under CPython's arena allocator; hard-abort on real errors
+        ASAN_OPTIONS="detect_leaks=0,abort_on_error=1",
+        UBSAN_OPTIONS="halt_on_error=1,print_stacktrace=1",
+        PYTHONPATH=repo,
+    )
+    import subprocess
+    import sys
+
+    p = subprocess.run(
+        [sys.executable, "-c", _SAN_DRIVER],
+        capture_output=True,
+        text=True,
+        timeout=600,
+        env=env,
+        cwd=repo,
+    )
+    assert p.returncode == 0, (
+        f"sanitized driver failed rc={p.returncode}\n--- stdout ---\n"
+        f"{p.stdout[-4000:]}\n--- stderr ---\n{p.stderr[-4000:]}"
+    )
+    assert "SAN_OK" in p.stdout
+
+
 def test_sighash_cold_build_stage_differential(cold_dir):
     cold = native._load_extension(
         "_sighash", str(cold_dir / "sighash.c"),
