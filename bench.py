@@ -464,6 +464,102 @@ def bench_scp_envelopes(n=4096, backend=None, reps=3, items=None):
     }
 
 
+def bench_byzantine_flood(n=2048, reps=3, items=None):
+    """Byzantine-flood fast-reject leg (ISSUE r12 satellite 2): invalid-
+    signature SCP-envelope triples at volume through the SHIPPED
+    CachingSigBackend — the overlay batch flush's CALLER_OVERLAY path —
+    reporting ``strict_gate_rejects_per_sec``, plus the bare native host
+    stage (native/sighash.c strict gate) on hostile-s signatures (s ≥ L:
+    rejected before any curve math — the cheapest-possible flood).
+
+    Asserts the quarantine-under-flood contract: the verify cache latches
+    NO verdict for any invalid-sig envelope, so a flood of distinct
+    invalid items cannot evict honest entries from the bounded LRU."""
+    import numpy as np
+
+    from stellar_tpu.crypto.sigbackend import (
+        CALLER_OVERLAY,
+        CachingSigBackend,
+        CpuSigBackend,
+    )
+    from stellar_tpu.crypto.sigcache import VerifySigCache
+
+    if items is None:
+        items = _scp_envelope_items(n)
+    n = len(items)
+    # class 1: well-formed but wrong signatures (fail the full verify)
+    flood = [
+        (pk, msg, sig[:-1] + bytes([sig[-1] ^ 0x01])) for pk, msg, sig in items
+    ]
+    cache = VerifySigCache()
+    be = CachingSigBackend(CpuSigBackend(), cache)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = be.verify_batch(flood, caller=CALLER_OVERLAY)
+        best = min(best, time.perf_counter() - t0)
+        assert not any(out), "flood signatures must all reject"
+    # the no-latch-invalid contract: nothing from the flood may be in the
+    # cache (peek + size — distinct invalid items, so any latch grows it)
+    keys = [cache.key_for(pk, sig, msg) for pk, msg, sig in flood]
+    latched = [v for v in cache.peek_many(keys) if v is not None]
+    assert not latched and len(cache) == 0, (
+        "verify cache latched %d invalid-sig verdicts under flood" % len(latched)
+    )
+    out = {
+        "strict_gate_rejects_per_sec": round(n / best, 1),
+        "n": n,
+        "cache_latched_invalid": 0,
+    }
+
+    # class 2: hostile-s (s >= L) through the bare native C stage — the
+    # strict gate's pre-curve reject rate, no sodium round trip
+    from stellar_tpu import native
+
+    mod = native.load_sighash()
+    if mod is not None:
+        ref = _ref25519_jaxfree()
+        hostile = [
+            (pk, msg, sig[:32] + int(ref.L + 7).to_bytes(32, "little"))
+            for pk, msg, sig in items
+        ]
+        blacklist = b"".join(ref.small_order_blacklist())
+        packed = np.empty((128, n), dtype=np.uint8)
+        okbuf = np.empty(n, dtype=np.uint8)
+        best_g = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            mod.stage(hostile, 0, n, packed, okbuf, blacklist)
+            best_g = min(best_g, time.perf_counter() - t0)
+        assert not okbuf.any(), "hostile-s flood must fail the strict gate"
+        out["gate_stage_rejects_per_sec"] = round(n / best_g, 1)
+    return out
+
+
+def bench_scenario_liveness(matrix="small", only=None, seed=1):
+    """Consensus-liveness-under-chaos legs (stellar_tpu/scenarios/): one
+    entry per fault class with ledgers/sec, recovery_ms, and the
+    fast-reject rate — the ISSUE r12 acceptance surface.  Relay-
+    independent (cpu-backend multi-node sims)."""
+    from stellar_tpu.scenarios import run_matrix
+
+    out = {}
+    for r in run_matrix(matrix=matrix, only=only, seed=seed):
+        sb = r.scoreboard
+        out[sb.fault_class] = {
+            "ok": r.ok,
+            "ledgers_closed": sb.ledgers_closed,
+            "ledgers_per_sec": sb.ledgers_per_sec,
+            "recovery_ms": sb.recovery_ms,
+            "fast_rejects_per_sec": sb.fast_reject_rate_per_sec,
+            "invariant_violations": sb.invariant_violations,
+            "digest": sb.digest(),
+        }
+        if not r.ok:
+            out[sb.fault_class]["failures"] = r.failures
+    return out
+
+
 def bench_libsodium_single_core(items, seconds=1.0):
     from stellar_tpu.crypto import sodium
 
@@ -566,6 +662,18 @@ def _main():
             _progress["scp_env"] = bench_scp_envelopes(items=scp_items)
         except Exception as e:
             print(f"# bench: scp-envelope cpu leg failed: {e}",
+                  file=sys.stderr)
+    # Byzantine-flood fast-reject leg (ISSUE r12): relay-independent,
+    # shares the envelope fixture; also pins the no-latch-invalid verify
+    # cache contract on every bench line
+    if os.environ.get("BENCH_FLOOD", "1") != "0" and scp_items is not None:
+        _progress.update(stage="byzantine-flood")
+        try:
+            _progress["byzantine_flood"] = bench_byzantine_flood(
+                items=scp_items[: min(len(scp_items), 2048)]
+            )
+        except Exception as e:
+            print(f"# bench: byzantine-flood leg failed: {e}",
                   file=sys.stderr)
     # Probe the relay from killable children BEFORE any in-process jax
     # backend touch; keep probing (45s pauses) while the watchdog budget
@@ -845,6 +953,25 @@ def _main():
                     )
                 except Exception as e:  # headline must still be reported
                     result["ledger_close_error"] = str(e)[:200]
+    # scenario_liveness legs (ISSUE r12): chaos-matrix liveness per fault
+    # class — relay-independent cpu sims, ~60-90s for the small matrix.
+    # BENCH_SCENARIOS=0 skips (the bench contract tests do); low watchdog
+    # budget skips rather than risking the verify headline.
+    if os.environ.get("BENCH_SCENARIOS", "1") != "0":
+        remaining = deadline - time.monotonic()
+        # worst case: catchup_load's own REAL-clock timeout is 150s, plus
+        # the four virtual sims' CPU-bound crank time — the gate must
+        # cover a fully-wedged matrix, not the healthy ~60-90s run
+        if remaining < 320.0:
+            result["scenario_liveness_skipped"] = (
+                f"only {remaining:.0f}s of watchdog budget left (<320s)"
+            )
+        else:
+            _progress.update(stage="scenario-liveness")
+            try:
+                result["scenario_liveness"] = bench_scenario_liveness()
+            except Exception as e:  # headline must still be reported
+                result["scenario_liveness_error"] = str(e)[:200]
     watchdog.cancel()
     if not _try_emit(result):
         return  # watchdog fired mid-close and already emitted; it exits

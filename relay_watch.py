@@ -113,6 +113,16 @@ FINAL_STEPS = [
      [sys.executable, "-u", "-m", "stellar_tpu.analysis",
       "stellar_tpu", "--json"],
      300),
+    # r12: consensus-liveness-under-chaos gate — the small scenario matrix
+    # (partition/heal, byzantine sig flood, slow-lossy links, validator
+    # crash/restart, catchup-under-load), relay-independent, exits nonzero
+    # on ANY invariant violation, chain disagreement, liveness-floor miss,
+    # unrecovered heal, or flood-polluted verify cache.  Runs here so
+    # every green window certifies the chaos plane next to the perf
+    # numbers it must not regress.
+    ("scenario_liveness_r12",
+     [sys.executable, "-u", "-m", "stellar_tpu.scenarios", "--json"],
+     600),
 ]
 ALL_NAMES = (
     [s[0] for s in SCRIPT_STEPS]

@@ -282,7 +282,14 @@ class DeterminismRule(Rule):
         " consensus-adjacent module — VirtualClock/seeded-RNG discipline"
     )
 
-    SCOPED = ("scp/", "herder/", "ledger/", "overlay/", "history/")
+    # simulation/ + scenarios/ joined in r12: the chaos plane's replay
+    # contract (same topology + seed + fault program ⇒ same run) holds
+    # only if every roll in the harness itself is seeded and all time
+    # flows through the clock
+    SCOPED = (
+        "scp/", "herder/", "ledger/", "overlay/", "history/",
+        "simulation/", "scenarios/",
+    )
     DATETIME_CALLS = {"now", "utcnow", "today"}
 
     def applies(self, ctx: FileContext) -> bool:

@@ -87,8 +87,12 @@ class PubKeyUtils:
         if hit:
             return val
         ok = sodium.verify_detached(signature, msg, key.value)
-        # analysis: off cache-latch -- synchronous single-verify memoization on the caller's own thread (the reference's SecretKey.cpp eager path): the verdict was just computed against live state, there is no async batch to quarantine
-        _verify_cache.put(cache_key, ok)
+        # valid verdicts only: the bounded LRU must be un-pollutable by a
+        # flood of distinct invalid-sig items (same contract as the batch
+        # paths in sigbackend.py; re-verifying an invalid item is pure)
+        if ok:
+            # analysis: off cache-latch -- synchronous single-verify memoization on the caller's own thread (the reference's SecretKey.cpp eager path): the verdict was just computed against live state, there is no async batch to quarantine
+            _verify_cache.put(cache_key, ok)
         return ok
 
     @staticmethod

@@ -97,7 +97,12 @@ class SigFlushFuture:
                 self._result = result
                 if self._latch is not None and not self._quarantined:
                     cache, key_rows = self._latch
-                    cache.put_many((k, result[i]) for k, i in key_rows)
+                    # valid verdicts only, mirroring the synchronous path:
+                    # the shared cache never holds an invalid-sig verdict
+                    # (flood cache-pollution defense)
+                    cache.put_many(
+                        (k, result[i]) for k, i in key_rows if result[i]
+                    )
                     self._latched = True
         self._done.set()
 
@@ -177,8 +182,13 @@ class CachingSigBackend(SigBackend):
             fresh = self.inner.verify_batch(
                 [items[i] for i in miss_idx], caller=caller
             )
+            # latch VALID verdicts only: a byzantine flood of distinct
+            # invalid-sig items must not be able to evict honest entries
+            # from the bounded LRU (cache-pollution defense; re-verifying
+            # an invalid item is cheap and pure, so nothing is lost) —
+            # the chaos plane's flood scenarios pin this contract
             self.cache.put_many(
-                (keys[i], ok) for i, ok in zip(miss_idx, fresh)
+                (keys[i], ok) for i, ok in zip(miss_idx, fresh) if ok
             )
             for i, ok in zip(miss_idx, fresh):
                 cached[i] = ok

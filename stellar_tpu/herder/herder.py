@@ -134,6 +134,16 @@ class Herder(SCPDriver):
         self._trace_nom_spans: Dict[int, object] = {}
         self._trace_ballot_spans: Dict[int, object] = {}
 
+        # consensus-liveness counters (chaos-plane scoreboard,
+        # stellar_tpu/scenarios/scoreboard.py): how many nomination rounds
+        # opened and how many ballot rounds (max counter reached per slot)
+        # consensus burned — under faults these climb while
+        # ledgers-closed/wall-time falls, which is exactly the liveness
+        # story the scoreboard tells
+        self.n_nomination_rounds = 0
+        self.n_ballot_rounds = 0
+        self._ballot_round_high: Dict[int, int] = {}
+
         m = app.metrics
         self.m_envelope_sign = m.new_meter(("scp", "envelope", "sign"), "envelope")
         self.m_envelope_validsig = m.new_meter(("scp", "envelope", "validsig"), "envelope")
@@ -162,6 +172,20 @@ class Herder(SCPDriver):
         if self.tracking:
             return self.tracking.index
         return self.ledger_manager.get_last_closed_ledger_num()
+
+    def shutdown(self) -> None:
+        """Cancel every timer this herder armed on the (possibly shared)
+        clock.  A crashed/stopped validator in a multi-node simulation must
+        never fire a trigger or rebroadcast against its closed database —
+        the chaos plane's crash/restart fault depends on this."""
+        self.pending_envelopes.shutdown()
+        self.trigger_timer.cancel()
+        self.rebroadcast_timer.cancel()
+        self.tracking_timer.cancel()
+        for slot_timers in self.scp_timers.values():
+            for t in slot_timers.values():
+                t.cancel()
+        self.scp_timers.clear()
 
     def bootstrap(self) -> None:
         """Force-join SCP from local state (FORCE_SCP; HerderImpl.cpp:160)."""
@@ -453,6 +477,7 @@ class Herder(SCPDriver):
         """Per-round nomination latency: round N's span closes when round
         N+1 starts (its timer fired), a ballot begins, or the slot
         externalizes."""
+        self.n_nomination_rounds += 1
         tr = self.app.tracer
         tr.end(self._trace_nom_spans.pop(slot_index, None))
         self._trace_nom_spans[slot_index] = tr.begin(
@@ -463,6 +488,11 @@ class Herder(SCPDriver):
         )
 
     def started_ballot_protocol(self, slot_index: int, ballot) -> None:
+        # liveness: the highest ballot counter this slot reached is its
+        # ballot-round count; accumulated into n_ballot_rounds when the
+        # slot externalizes (or discarded with the stale-slot sweep there)
+        high = self._ballot_round_high.get(slot_index, 0)
+        self._ballot_round_high[slot_index] = max(high, ballot.counter)
         tr = self.app.tracer
         tr.end(self._trace_nom_spans.pop(slot_index, None))
         # only the FIRST ballot opens the span — later bump_state calls are
@@ -477,6 +507,7 @@ class Herder(SCPDriver):
     # ------------------------------------------------------------------
     def value_externalized(self, slot_index: int, value: bytes) -> None:
         self.m_value_externalize.mark()
+        self.n_ballot_rounds += self._ballot_round_high.pop(slot_index, 0)
         tr = self.app.tracer
         tr.end(self._trace_nom_spans.pop(slot_index, None))
         tr.end(self._trace_ballot_spans.pop(slot_index, None))
@@ -485,6 +516,7 @@ class Herder(SCPDriver):
             self._trace_nom_spans,
             self._trace_ballot_spans,
             self._trace_slot_spans,
+            self._ballot_round_high,
         ):
             for stale in [s for s in d if s < slot_index]:
                 d.pop(stale)
@@ -634,7 +666,32 @@ class Herder(SCPDriver):
             max_seq = min_seq + LEDGER_VALIDITY_BRACKET
             if not (min_seq <= envelope.statement.slotIndex <= max_seq):
                 return
+        # flood fast-reject (the reference's eager verify,
+        # HerderImpl.cpp:347-364): an envelope whose signature fails must
+        # never reach the fetch plane — a byzantine flood of invalid-sig
+        # envelopes referencing made-up qset/txset hashes would otherwise
+        # wedge in `fetching` forever AND spray item-fetch requests for
+        # hashes nobody has.  The overlay's per-crank batch flush already
+        # verified (and dropped) its batch, so this check is a warm-cache
+        # hit for every honest envelope; only the reject marks here — the
+        # accept mark stays at SCP's own pre-process verify so
+        # validsig/invalidsig stay one-mark-per-envelope.
+        ok = PubKeyUtils.verify_sig(
+            envelope.statement.nodeID,
+            envelope.signature,
+            self._envelope_payload(envelope),
+        )
+        if not ok:
+            self.m_envelope_invalidsig.mark()
+            return
         self.pending_envelopes.recv_scp_envelope(envelope)
+
+    def note_envelope_rejected(self, envelope: SCPEnvelope) -> None:
+        """The overlay's batch flush verified this envelope's signature
+        invalid and dropped it before the herder — account it exactly like
+        the eager-reject path above would have."""
+        self.m_envelope_receive.mark()
+        self.m_envelope_invalidsig.mark()
 
     def recv_scp_quorum_set(self, qs_hash: bytes, qset: SCPQuorumSet) -> None:
         self.pending_envelopes.recv_scp_quorum_set(qs_hash, qset)
@@ -649,14 +706,30 @@ class Herder(SCPDriver):
         return self.pending_envelopes.get_tx_set(ts_hash)
 
     def process_scp_queue(self) -> None:
-        if self.tracking:
-            self.pending_envelopes.erase_below(self.next_consensus_ledger_index())
-            self._process_scp_queue_at_index(self.next_consensus_ledger_index())
-        else:
-            for slot in self.pending_envelopes.ready_slots():
-                self._process_scp_queue_at_index(slot)
-                if self.tracking:
-                    break  # a slot externalized; back to the regular flow
+        # drain holdoff around the whole sweep: when several slots are
+        # externalizable (a healed partition's replay run readied them in
+        # one batch), each value_externalized ENQUEUES through the close
+        # pipeline and the closes happen at release as one pipelined
+        # backlog — slot N+1's signature prewarm dispatches while slot N
+        # applies (ledger/closepipeline.py; ROADMAP #3's remaining leg).
+        # Everything is still synchronous within this call: by return,
+        # every enqueued ledger has closed.
+        self.ledger_manager.hold_pipeline_drains()
+        try:
+            if self.tracking:
+                self.pending_envelopes.erase_below(
+                    self.next_consensus_ledger_index()
+                )
+                self._process_scp_queue_at_index(
+                    self.next_consensus_ledger_index()
+                )
+            else:
+                for slot in self.pending_envelopes.ready_slots():
+                    self._process_scp_queue_at_index(slot)
+                    if self.tracking:
+                        break  # a slot externalized; back to the regular flow
+        finally:
+            self.ledger_manager.release_pipeline_drains()
 
     def _process_scp_queue_at_index(self, slot_index: int) -> None:
         while True:

@@ -55,6 +55,8 @@ class PendingEnvelopes:
         self.pending: Dict[int, List[SCPEnvelope]] = {}
         self.qset_cache = _LRU(QSET_CACHE_SIZE)
         self.txset_cache = _LRU(TXSET_CACHE_SIZE)
+        self._recheck_posted = False
+        self._shut_down = False
         self._size_counter = app.metrics.new_counter(
             ("scp", "memory", "pending-envelopes")
         )
@@ -65,13 +67,49 @@ class PendingEnvelopes:
         om = self.app.overlay_manager
         if om is not None:
             om.qset_fetcher.recv(qs_hash)
-        self._recheck_fetching()
+        self._post_recheck()
 
     def recv_tx_set(self, ts_hash: bytes, txset) -> None:
         self.txset_cache.put(ts_hash, txset)
         om = self.app.overlay_manager
         if om is not None:
             om.tx_set_fetcher.recv(ts_hash)
+        self._post_recheck()
+
+    def _post_recheck(self) -> None:
+        """Coalesce dependency rechecks per crank (the overlay's SCP-batch
+        idiom): fetch responses for several items routinely land in one
+        delivery burst, and per-message rechecks both rescan ``fetching``
+        O(items × envelopes) and — worse — cascade each newly-ready
+        EXTERNALIZE into a synchronous ledger close MID-BURST.  One posted
+        sweep readies the whole batch first, so a healed/lagging node's
+        missed slots externalize back-to-back and drain through the close
+        pipeline as a real >1-ledger backlog (dispatch-ahead prewarms the
+        next txset while the current one applies) instead of closing
+        serially inside the message handlers."""
+        if self._recheck_posted:
+            return
+        # nothing wedged ⇒ nothing a recheck could ready — do NOT post:
+        # an unconditional post would keep every crank non-idle, and a
+        # VIRTUAL clock never leaps to its next timer while cranks have
+        # work (the herder's own trigger path calls recv_tx_set on every
+        # proposal, so this would freeze virtual time on quiet nodes)
+        if not any(self.fetching.values()):
+            return
+        self._recheck_posted = True
+        self.app.clock.post(self._run_posted_recheck)
+
+    def shutdown(self) -> None:
+        """Neutralize any already-posted recheck: clock.post callbacks
+        cannot be cancelled, and a crashed/stopped node's posted sweep
+        must not externalize ledgers against a closed database (the
+        chaos plane's crash fault fires mid-crank)."""
+        self._shut_down = True
+
+    def _run_posted_recheck(self) -> None:
+        self._recheck_posted = False
+        if self._shut_down:
+            return
         self._recheck_fetching()
 
     def get_qset(self, qs_hash: bytes) -> Optional[SCPQuorumSet]:
@@ -144,7 +182,7 @@ class PendingEnvelopes:
             self._size_counter.inc()
             self._start_fetch(envelope)
 
-    def _envelope_ready(self, envelope: SCPEnvelope) -> None:
+    def _envelope_ready(self, envelope: SCPEnvelope, process: bool = True) -> None:
         slot = envelope.statement.slotIndex
         key = envelope.to_xdr()
         self.processed.setdefault(slot, {})[key] = envelope
@@ -159,7 +197,8 @@ class PendingEnvelopes:
                 StellarMessage(MessageType.SCP_MESSAGE, envelope)
             )
         self.pending.setdefault(slot, []).append(envelope)
-        self.herder.process_scp_queue()
+        if process:
+            self.herder.process_scp_queue()
 
     def _recheck_fetching(self) -> None:
         ready = []
@@ -169,8 +208,14 @@ class PendingEnvelopes:
                     del envs[key]
                     self._size_counter.dec()
                     ready.append(env)
+        # queue the WHOLE ready batch before processing: when the batch
+        # spans several externalizable slots (a lagging node's replay),
+        # the herder's sweep sees them all pending and the ledger closes
+        # drain as one pipelined backlog rather than one close per item
         for env in ready:
-            self._envelope_ready(env)
+            self._envelope_ready(env, process=False)
+        if ready:
+            self.herder.process_scp_queue()
 
     def pop(self, slot_index: int) -> Optional[SCPEnvelope]:
         lst = self.pending.get(slot_index)

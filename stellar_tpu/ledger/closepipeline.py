@@ -71,6 +71,11 @@ class ClosePipeline:
         # upcoming txsets eligible for a prewarm dispatch: key -> [txs]
         self._candidates: "dict[bytes, list]" = {}
         self._draining = False
+        # >0: a multi-slot SCP sweep is in progress (Herder.process_scp_
+        # queue) — enqueues accumulate and the drain runs at release, so a
+        # lagging node's replayed run closes as ONE pipelined backlog
+        self._held = 0
+        self.n_held_sweeps = 0  # sweeps that released a >1 backlog
         # overlap accounting (bench.py overlap_hidden_ms / profile_close
         # --pipeline-report read these)
         self.n_dispatched = 0
@@ -93,16 +98,40 @@ class ClosePipeline:
         self._queue.append(ledger_data)
         self.note_upcoming(ledger_data.tx_set.transactions)
 
+    def hold(self) -> None:
+        """Open a drain holdoff (reentrancy-counted): enqueues accumulate
+        until the matching ``release``.  The herder wraps its SCP-queue
+        sweep in a hold so several externalizable slots — a healed
+        partition's replay, a post-flood burst — enqueue as ONE run and
+        the release drains them pipelined (dispatch-ahead prewarms slot
+        N+1's signatures while slot N applies).  Without the hold, each
+        ``value_externalized`` closes synchronously inside its own notify
+        cascade and the queue never stacks."""
+        self._held += 1
+
+    def release(self) -> bool:
+        """Close a holdoff; True when this was the outermost one (the
+        caller then drains)."""
+        assert self._held > 0, "release without hold"
+        self._held -= 1
+        return self._held == 0
+
+    def held(self) -> bool:
+        return self._held > 0
+
     def drain(self, close_fn) -> None:
         """Close queued ledgers in order via ``close_fn(ledger_data)``.
         Reentrant submits during a close (herder notify cascading into the
-        next externalize) just enqueue — the outer drain picks them up.
+        next externalize) just enqueue — the outer drain picks them up;
+        during a hold (SCP sweep) the whole drain defers to the release.
         A failed close quarantines every in-flight future (the abort
         contract), returns the failed ledger to the queue head, and
         propagates — a retry drain resumes from the same ledger, and a
         catchup interrupt collects the full unclosed run."""
-        if self._draining:
+        if self._draining or self._held:
             return
+        if len(self._queue) > 1:
+            self.n_held_sweeps += 1
         self._draining = True
         try:
             # a previous aborted drain quarantined in-flight futures AND
@@ -265,6 +294,7 @@ class ClosePipeline:
     def stats(self) -> dict:
         return {
             "depth": self.depth,
+            "backlog_drains": self.n_held_sweeps,
             "queued": len(self._queue),
             "inflight": len(self._futures),
             "dispatched": self.n_dispatched,

@@ -207,6 +207,20 @@ class LedgerManager:
             self.state = LedgerState.LM_SYNCED_STATE
         self.app.herder_notify_ledger_closed()
 
+    def hold_pipeline_drains(self) -> None:
+        """Defer pipelined closes until the matching release — the herder
+        brackets its SCP-queue sweep with this pair so a run of
+        externalizable slots (healed partition replay, post-flood burst)
+        enqueues whole and closes as one pipelined backlog."""
+        pipe = self._close_pipeline()
+        if pipe is not None:
+            pipe.hold()
+
+    def release_pipeline_drains(self) -> None:
+        pipe = self._close_pipeline()
+        if pipe is not None and pipe.release():
+            pipe.drain(self._close_externalized)
+
     def externalize_value(self, ledger_data) -> None:
         if self.state == LedgerState.LM_CATCHING_UP_STATE:
             # keep buffering while the catchup FSM runs (:389-399)

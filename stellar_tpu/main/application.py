@@ -177,6 +177,11 @@ class Application:
             self.command_handler.start()
 
     def graceful_stop(self) -> None:
+        if self.herder is not None:
+            # cancel consensus timers before anything closes: on a shared
+            # simulation clock a dead node's trigger/rebroadcast timer
+            # would otherwise fire against a closed database
+            self.herder.shutdown()
         if self.overlay_manager is not None:
             self.overlay_manager.shutdown()
         if self.command_handler is not None:

@@ -33,6 +33,9 @@ class Floodgate:
         self.flood_map: Dict[bytes, FloodRecord] = {}
         self._shutting_down = False
         self.m_added = app.metrics.new_counter(("overlay", "memory", "flood-known"))
+        # cumulative per-peer sends (flood fan-out) — the chaos plane's
+        # scoreboard reads this as "how much the network amplified"
+        self.n_sent = 0
 
     @staticmethod
     def message_key(msg: StellarMessage) -> bytes:
@@ -88,6 +91,7 @@ class Floodgate:
                 rec.peers_told.add(peer)
                 peer.send_message(msg)
                 sent += 1
+        self.n_sent += sent
         tracer.end(
             sp, msg_type=getattr(msg.type, "name", str(msg.type)), sent=sent
         )

@@ -3,25 +3,61 @@
 
 A pair of Peers whose transports are each other's in-memory queues, with
 fault injection: per-message drop / duplicate / reorder / byte-damage
-probabilities, cork control, and queue bounding — the byzantine test rig
-(LoopbackPeer.h:24-100).  Delivery is explicit (``deliver_one`` /
-``deliver_all``) or scheduled on the clock, so tests and the Simulation can
-crank message-by-message deterministically.
+probabilities, cork control, queue bounding, and a lossy/latency delivery
+mode — the byzantine test rig (LoopbackPeer.h:24-100).  Delivery is
+explicit (``deliver_one`` / ``deliver_all``) or scheduled on the clock, so
+tests and the Simulation can crank message-by-message deterministically;
+with ``latency`` set, scheduled delivery rides a VirtualTimer instead of
+the next crank, modeling a slow link under the same (virtual or real)
+clock.
 """
 
 from __future__ import annotations
 
 import random
 from collections import deque
+from dataclasses import dataclass
 from typing import Deque, Optional
 
-from ..util import xlog
+from ..util import VirtualTimer, xlog
 from ..xdr.overlay import MessageType
 from .peer import Peer, PeerRole
 
 log = xlog.logger("Overlay")
 
 MAX_QUEUE_DEPTH = 1000
+
+
+@dataclass
+class FaultProfile:
+    """One link side's fault knobs, as the chaos plane schedules them
+    (stellar_tpu/scenarios/faults.py).  ``latency`` is seconds of delivery
+    delay on the link; the probabilistic knobs map 1:1 onto the
+    LoopbackPeer attributes of the same name.  NOTE: post-handshake, any
+    drop/duplicate/reorder/damage that actually fires breaks the peers'
+    MAC sequence and costs the CONNECTION (exactly like losing bytes
+    inside a TCP stream) — a lossy profile therefore models link FLAPS,
+    and liveness comes from the scenario's link doctor re-establishing
+    the pair plus SCP rebroadcast."""
+
+    drop: float = 0.0
+    duplicate: float = 0.0
+    reorder: float = 0.0
+    damage: float = 0.0
+    latency: float = 0.0
+
+    def apply(self, peer: "LoopbackPeer", seed: Optional[int] = None) -> None:
+        peer.drop_prob = self.drop
+        peer.duplicate_prob = self.duplicate
+        peer.reorder_prob = self.reorder
+        peer.damage_prob = self.damage
+        peer.latency = self.latency
+        if seed is not None:
+            # scenario-scoped determinism: the per-process ctor nonce makes
+            # pairs uncorrelated but NOT replayable across two runs in one
+            # process — a chaos run reseeds every armed peer from its own
+            # seed space so the same fault program rolls the same faults
+            peer._rng = random.Random(seed)
 
 
 class LoopbackPeer(Peer):
@@ -42,6 +78,12 @@ class LoopbackPeer(Peer):
         self.reorder_prob = 0.0
         self.damage_cert = False
         self.damage_auth = False
+        # lossy/latency delivery mode: >0 delays each scheduled pump by
+        # this many (clock) seconds — frames sent while the pump is armed
+        # ride the same delayed batch, the "slow link" shape
+        self.latency = 0.0
+        self._latency_timer: Optional[VirtualTimer] = None
+        self._latency_armed = False
         # seeded: fault-injection rolls (drop/damage/reorder) must replay
         # identically so a chaos run that found a bug can be re-run
         # (determinism rule; probabilities default 0.0, so the seed is
@@ -130,7 +172,25 @@ class LoopbackPeer(Peer):
         self.out_queue.clear()
 
     def _schedule_delivery(self) -> None:
-        self.app.clock.post(self._pump)
+        if self.latency > 0:
+            if self._latency_armed:
+                return  # queued frames ride the already-armed pump
+            if self._latency_timer is None:
+                self._latency_timer = VirtualTimer(self.app.clock)
+            self._latency_armed = True
+            self._latency_timer.expires_from_now(self.latency)
+            self._latency_timer.async_wait(self._latency_pump)
+        else:
+            self.app.clock.post(self._pump)
+
+    def _latency_pump(self) -> None:
+        self._latency_armed = False
+        self._pump()
+        # frames that arrived while this pump ran (or that a fault
+        # re-queued) wait a fresh latency window, like bytes behind a
+        # slow link's send buffer
+        if self.out_queue and not self.corked and not self._closed:
+            self._schedule_delivery()
 
     def _pump(self) -> None:
         if not self.corked:

@@ -50,6 +50,12 @@ class OverlayManager:
         self.m_scp_batch_size = app.metrics.new_counter(
             ("overlay", "scp-batch", "envelopes")
         )
+        # byzantine-flood fast rejects: envelopes the per-crank batch
+        # verify found invalid and dropped at this boundary (the herder
+        # never sees them; chaos-plane scoreboards read this)
+        self.m_scp_batch_rejected = app.metrics.new_counter(
+            ("overlay", "scp-batch", "rejected")
+        )
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
@@ -210,11 +216,23 @@ class OverlayManager:
         # flush (or by a pipelined prewarm) stays scoped to its plane
         from ..crypto.sigbackend import CALLER_OVERLAY
 
-        self.app.sig_backend.verify_batch(triples, caller=CALLER_OVERLAY)
+        verdicts = self.app.sig_backend.verify_batch(
+            triples, caller=CALLER_OVERLAY
+        )
         self.m_scp_batch_flush.mark()
         self.m_scp_batch_size.inc(len(batch))
-        for env in batch:
-            herder.recv_scp_envelope(env)
+        # strict-gate fast-reject at the flood boundary: the batch verify
+        # just computed every verdict, so invalid-sig envelopes drop HERE
+        # — they never reach the herder's fetch plane, and (since the
+        # verify cache latches only valid verdicts) they cannot park a
+        # verdict in the shared cache either.  Valid envelopes flow on;
+        # the herder's eager re-check is a warm-cache hit.
+        for env, ok in zip(batch, verdicts):
+            if ok:
+                herder.recv_scp_envelope(env)
+            else:
+                self.m_scp_batch_rejected.inc()
+                herder.note_envelope_rejected(env)
 
     def recv_flooded_msg(self, msg: StellarMessage, peer: Peer) -> bool:
         """Record a flooded message arrival; False if already seen."""

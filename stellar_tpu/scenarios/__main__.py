@@ -1,0 +1,72 @@
+"""CLI: run the chaos-scenario matrix and report liveness.
+
+    python -m stellar_tpu.scenarios [--matrix small|big] [--only CLS[,CLS]]
+                                    [--seed N] [--json]
+
+One line per scenario; exits nonzero when ANY scenario fails — invariant
+violation, chain disagreement, liveness-floor miss, unrecovered heal, or
+a polluted verify cache under flood.  This is the relay_watch
+``scenario_liveness_r12`` step's entry point.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .matrix import FAULT_CLASSES, run_matrix
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="stellar_tpu.scenarios")
+    ap.add_argument("--matrix", choices=("small", "big"), default="small")
+    ap.add_argument(
+        "--only",
+        help="comma-separated fault classes (%s)" % ",".join(FAULT_CLASSES),
+    )
+    ap.add_argument("--seed", type=int, default=1)
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    args = ap.parse_args(argv)
+
+    only = args.only.split(",") if args.only else None
+    if only:
+        unknown = [c for c in only if c not in FAULT_CLASSES]
+        if unknown:
+            print("unknown fault class(es): %s" % ",".join(unknown),
+                  file=sys.stderr)
+            return 2
+
+    results = run_matrix(matrix=args.matrix, only=only, seed=args.seed)
+    any_fail = False
+    for r in results:
+        if args.as_json:
+            print(json.dumps(r.to_dict(), sort_keys=True))
+        else:
+            sb = r.scoreboard
+            print(
+                "%-24s %-4s ledgers=%d (%.2f/s) nom=%d ballot=%d "
+                "rejects=%d recovery=%s inv=%d digest=%s"
+                % (
+                    r.name,
+                    "ok" if r.ok else "FAIL",
+                    sb.ledgers_closed,
+                    sb.ledgers_per_sec,
+                    sb.nomination_rounds,
+                    sb.ballot_rounds,
+                    sb.fast_rejects,
+                    ("%.0fms" % sb.recovery_ms)
+                    if sb.recovery_ms is not None
+                    else "-",
+                    sb.invariant_violations,
+                    sb.digest(),
+                )
+            )
+            for f in r.failures:
+                print("    FAIL: %s" % f)
+        any_fail = any_fail or not r.ok
+    return 1 if any_fail else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,350 @@
+"""Fault programs — the scheduled adversities a scenario runs under.
+
+Each fault is a declarative event (or event pair) with clock offsets; the
+Scenario runner arms them on the simulation's clock at start.  Faults talk
+only to the Simulation's chaos surface (partition/heal/crash_node/
+restart_node/set_link_faults/ensure_links) and to the public node APIs the
+reference's byzantine tests use (enqueue_scp_envelope, recv_transaction),
+so a fault program composes with any topology.
+
+Determinism: every fault that rolls randomness derives its RNG from the
+scenario seed (never the module-level ``random``), and link-fault knobs
+reseed the LoopbackPeer fault RNGs through the simulation's
+``set_fault_seed`` plumbing — same topology + seed + program ⇒ identical
+faults, identical scoreboard (the replay contract).
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from ..overlay.loopback import FaultProfile
+from ..util import VirtualTimer, xlog
+
+log = xlog.logger("Scenario")
+
+
+class Fault:
+    """Base: subclasses implement ``arm(scn)`` — schedule whatever timers
+    the fault needs on ``scn.sim.clock`` (offsets are seconds from the
+    moment the fault program arms, i.e. after stabilization)."""
+
+    def arm(self, scn) -> None:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def describe(self) -> str:
+        return type(self).__name__
+
+    # shared helper: one-shot timer on the scenario clock.  ``slot`` names
+    # a reusable timer on this fault — recurring ticks (flood cadence,
+    # lag polls) re-arm ONE timer instead of allocating a fresh
+    # VirtualTimer per tick (all of which the scenario would retain for
+    # teardown cancellation).
+    def _at(self, scn, delay: float, fn, slot: Optional[str] = None) -> None:
+        if slot is None:
+            t = VirtualTimer(scn.sim.clock)
+            scn._fault_timers.append(t)
+        else:
+            slots = self.__dict__.setdefault("_timer_slots", {})
+            t = slots.get(slot)
+            if t is None:
+                t = slots[slot] = VirtualTimer(scn.sim.clock)
+                scn._fault_timers.append(t)
+        t.expires_from_now(max(0.0, delay))
+        t.async_wait(fn)
+
+
+@dataclass
+class Partition(Fault):
+    """Split the topology into ``groups`` (lists of node indices) at ``at``;
+    heal at ``heal_at`` (None = never — the scenario end heals).  The heal
+    stamps the scoreboard's recovery clock.
+
+    ``heal_lag`` (with ``heal_at`` as the backstop deadline) heals as soon
+    as the fastest group has closed ``heal_lag`` ledgers past the slowest
+    — the shape that pins a REPLAYABLE lag: ≤ the SCP state window
+    (MAX_SLOTS_TO_REMEMBER), so the laggards replay the missed slots from
+    peers' state as one pipelined close backlog instead of needing a
+    history archive.  Leader-election stalls right after the split make a
+    pure-time heal roll the dice on how much lag actually built; the
+    lag-polled heal is deterministic about it."""
+
+    at: float
+    heal_at: Optional[float]
+    groups: List[List[int]]
+    heal_lag: Optional[int] = None
+    poll: float = 0.25
+
+    def arm(self, scn) -> None:
+        healed = []
+
+        def split():
+            keys = [[scn.node_keys[i] for i in g] for g in self.groups]
+            scn.sim.partition(*keys)
+            scn.note("partition at t=%.1f: %s" % (scn.elapsed(), self.groups))
+            if self.heal_lag is not None:
+                self._at(scn, self.poll, poll_lag, slot='poll')
+
+        def heal(reason):
+            if healed or scn.done:
+                return
+            healed.append(True)
+            scn.sim.heal()
+            scn.mark_recovery_start()
+            scn.note("heal at t=%.1f (%s)" % (scn.elapsed(), reason))
+
+        def poll_lag():
+            if healed or scn.done:
+                return
+            lcls = scn.sim.ledger_nums()
+            if lcls and max(lcls) - min(lcls) >= self.heal_lag:
+                heal("lag=%d" % (max(lcls) - min(lcls)))
+            else:
+                self._at(scn, self.poll, poll_lag, slot='poll')
+
+        self._at(scn, self.at, split)
+        if self.heal_at is not None:
+            self._at(scn, self.heal_at, lambda: heal("deadline"))
+
+
+@dataclass
+class SlowLossyLinks(Fault):
+    """Arm a lossy/latency FaultProfile on every link at ``at`` (and back
+    to clean at ``heal_at``).  Post-handshake loss/damage flaps the
+    connection (MAC-sequence break, overlay/loopback.py) — the scenario's
+    link doctor re-establishes flapped pairs each tick, so what this
+    models is a degraded, flapping network that consensus must ride out."""
+
+    at: float
+    heal_at: Optional[float] = None
+    profile: FaultProfile = field(
+        default_factory=lambda: FaultProfile(
+            drop=0.02, duplicate=0.02, reorder=0.03, damage=0.01,
+            latency=0.05,
+        )
+    )
+
+    def arm(self, scn) -> None:
+        def degrade():
+            scn.sim.set_link_faults(self.profile)
+            scn.note("links degraded at t=%.1f" % scn.elapsed())
+
+        self._at(scn, self.at, degrade)
+        if self.heal_at is not None:
+            def restore():
+                scn.sim.set_link_faults(FaultProfile())
+                scn.sim.ensure_links()
+                scn.mark_recovery_start()
+                scn.note("links clean at t=%.1f" % scn.elapsed())
+
+            self._at(scn, self.heal_at, restore)
+
+
+@dataclass
+class CrashRestart(Fault):
+    """Take node ``node`` down hard at ``at``; bring it back on its
+    on-disk state at ``restart_at`` (requires a disk-backed DATABASE,
+    which the Scenario provisions for fault programs containing this).
+    The restart stamps the recovery clock."""
+
+    at: float
+    restart_at: float
+    node: int
+
+    def arm(self, scn) -> None:
+        key = scn.node_keys[self.node]
+
+        def crash():
+            scn.sim.crash_node(key)
+            scn.note("crashed node %d at t=%.1f" % (self.node, scn.elapsed()))
+
+        def restart():
+            scn.sim.restart_node(key)
+            scn.mark_recovery_start()
+            scn.note("restarted node %d at t=%.1f" % (self.node, scn.elapsed()))
+
+        self._at(scn, self.at, crash)
+        self._at(scn, self.restart_at, restart)
+
+
+@dataclass
+class ByzantineFlood(Fault):
+    """Invalid-signature envelope + transaction flood at volume, against
+    ``target`` (node index), between ``at`` and ``until`` on a ``tick``
+    cadence.  Envelopes ride the overlay's per-crank batch flush — the
+    strict-gate fast-reject path under CALLER_OVERLAY — and reference
+    made-up qset/txset hashes, so any regression of the eager reject
+    would wedge the fetch plane (the scenario asserts it stays empty).
+    Transactions carry garbage signatures through recv_transaction.
+
+    The fault records every injected envelope's verify-cache key:
+    ``assert_cache_unpolluted`` pins the no-latch-invalid contract
+    (ISSUE r12 satellite 2) after the run."""
+
+    at: float
+    until: float
+    target: int = 0
+    envelopes_per_tick: int = 25
+    txs_per_tick: int = 5
+    tick: float = 0.5
+
+    def __post_init__(self):
+        self.n_envelopes = 0
+        self.n_txs = 0
+        self._cache_keys: List[bytes] = []
+
+    def arm(self, scn) -> None:
+        self._rng = random.Random(scn.spec.seed ^ 0xF100D)
+        self._at(scn, self.at, lambda: self._tick_fn(scn), slot='tick')
+
+    # -- injection ----------------------------------------------------------
+    def _tick_fn(self, scn) -> None:
+        if scn.elapsed_since_arm() >= self.until or scn.done:
+            return
+        app = scn.sim.nodes.get(
+            scn.sim._raw_key(scn.node_keys[self.target])
+        )
+        if app is not None:
+            for _ in range(self.envelopes_per_tick):
+                self._inject_envelope(app)
+            for _ in range(self.txs_per_tick):
+                self._inject_tx(app)
+        self._at(scn, self.tick, lambda: self._tick_fn(scn), slot='tick')
+
+    def _forged_envelope(self, app):
+        from ..crypto.keys import SecretKey
+        from ..xdr.ledger import StellarValue
+        from ..xdr.scp import (
+            SCPEnvelope,
+            SCPNomination,
+            SCPStatement,
+            SCPStatementPledges,
+            SCPStatementType,
+        )
+
+        signer = SecretKey.pseudo_random_for_testing(
+            30_000_000 + self._rng.randrange(1 << 30)
+        )
+        sv = StellarValue(
+            txSetHash=self._rng.randbytes(32),
+            closeTime=app.time_now() + 1,
+            upgrades=[],
+            ext=0,
+        )
+        nom = SCPNomination(
+            quorumSetHash=self._rng.randbytes(32),
+            votes=[sv.to_xdr()],
+            accepted=[],
+        )
+        st = SCPStatement(
+            nodeID=signer.get_public_key(),
+            slotIndex=app.herder.next_consensus_ledger_index(),
+            pledges=SCPStatementPledges(
+                SCPStatementType.SCP_ST_NOMINATE, nom
+            ),
+        )
+        return SCPEnvelope(statement=st, signature=self._rng.randbytes(64))
+
+    def _inject_envelope(self, app) -> None:
+        env = self._forged_envelope(app)
+        pk, msg, sig = app.herder.envelope_verify_triple(env)
+        from ..crypto.keys import verify_cache
+
+        self._cache_keys.append(verify_cache().key_for(pk, sig, msg))
+        app.overlay_manager.enqueue_scp_envelope(env)
+        self.n_envelopes += 1
+
+    def _inject_tx(self, app) -> None:
+        from ..crypto.keys import SecretKey
+        from ..tx import testutils as T
+        from ..tx.frame import TransactionFrame
+        import stellar_tpu.xdr as X
+
+        src = SecretKey.pseudo_random_for_testing(
+            40_000_000 + self._rng.randrange(1 << 30)
+        )
+        dst = SecretKey.pseudo_random_for_testing(
+            40_000_000 + self._rng.randrange(1 << 30)
+        )
+        tx = X.Transaction(
+            sourceAccount=src.get_public_key(),
+            fee=100,
+            seqNum=self._rng.randrange(1, 1 << 40),
+            timeBounds=None,
+            memo=X.Memo.none(),
+            operations=[T.payment_op(dst, 1)],
+            ext=0,
+        )
+        frame = TransactionFrame(
+            app.network_id, X.TransactionEnvelope(tx, [])
+        )
+        frame.add_signature(src)
+        # corrupt the signature AFTER signing: a structurally-plausible
+        # envelope whose sig fails strict verification
+        sig = bytearray(frame.envelope.signatures[0].signature)
+        sig[0] ^= 0xFF
+        frame.envelope.signatures[0].signature = bytes(sig)
+        app.herder.recv_transaction(frame)
+        self.n_txs += 1
+
+    # -- oracles -------------------------------------------------------------
+    def assert_cache_unpolluted(self) -> int:
+        """The shared verify cache must hold NO verdict for any flooded
+        invalid-sig envelope (the no-latch-invalid / quarantine-under-
+        flood contract).  Returns how many keys were checked."""
+        from ..crypto.keys import verify_cache
+
+        latched = [
+            v for v in verify_cache().peek_many(self._cache_keys)
+            if v is not None
+        ]
+        if latched:
+            raise AssertionError(
+                "%d/%d flooded invalid-sig envelopes latched a verdict in"
+                " the shared verify cache" % (len(latched), len(self._cache_keys))
+            )
+        return len(self._cache_keys)
+
+
+@dataclass
+class PartitionUntilCheckpoint(Fault):
+    """The catchup-under-load shape: partition ``lagger`` off at ``at``
+    and heal only once the majority's LCL has crossed
+    ``heal_after_ledger`` — far enough that the lagger's SCP gap exceeds
+    MAX_SLOTS_TO_REMEMBER and it must catch up from the history archive
+    while the network keeps closing under load."""
+
+    at: float
+    heal_after_ledger: int
+    lagger: int
+    poll: float = 0.5
+
+    def arm(self, scn) -> None:
+        lag_key = scn.node_keys[self.lagger]
+        rest = [k for i, k in enumerate(scn.node_keys) if i != self.lagger]
+
+        def split():
+            scn.sim.partition(rest, [lag_key])
+            scn.note("catchup-lag partition at t=%.1f" % scn.elapsed())
+            self._at(scn, self.poll, poll, slot='poll')
+
+        def poll():
+            if scn.done:
+                return
+            majority = max(
+                scn.sim.get_node(k).ledger_manager.get_last_closed_ledger_num()
+                for k in rest
+            )
+            if majority >= self.heal_after_ledger:
+                scn.sim.heal()
+                scn.mark_recovery_start()
+                scn.note(
+                    "heal at t=%.1f (majority lcl=%d)"
+                    % (scn.elapsed(), majority)
+                )
+            else:
+                self._at(scn, self.poll, poll, slot='poll')
+
+        self._at(scn, self.at, split)

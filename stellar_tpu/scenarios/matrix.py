@@ -1,0 +1,210 @@
+"""The scenario matrix — named shapes per fault class.
+
+SMALL shapes run in tier-1 (each ≥10 ledgers closed in the chaos window,
+invariants all-on, deterministic seeded replay for the virtual-clock
+classes); BIG shapes are the same programs at core-and-tier ring scale
+and longer fault windows, behind ``-m slow`` / the relay_watch
+``scenario_liveness_r12`` step's ``--matrix big`` mode.
+
+Fault classes (ROADMAP #5 / ISSUE r12 acceptance):
+- ``partition_heal``    — majority/minority split, heal, lagging node
+                          replays the missed slots through ClosePipeline
+- ``byzantine_flood``   — invalid-signature envelope + tx flood at volume
+                          (strict-gate fast-reject, CALLER_OVERLAY plane)
+- ``slow_lossy``        — latency + loss/duplicate/reorder/damage on every
+                          link; flapped connections re-established by the
+                          link doctor
+- ``crash_restart``     — validator hard-crash with a 3-of-3 quorum (the
+                          network halts) and restart from its on-disk
+                          state; recovery time measured
+- ``catchup_load``      — node partitioned past MAX_SLOTS_TO_REMEMBER
+                          while the network closes through checkpoint
+                          boundaries under load; rejoin via history-archive
+                          catchup (REAL_TIME clock, like the history suite)
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..overlay.loopback import FaultProfile
+from .faults import (
+    ByzantineFlood,
+    CrashRestart,
+    Partition,
+    PartitionUntilCheckpoint,
+    SlowLossyLinks,
+)
+from .scenario import Scenario, ScenarioResult, ScenarioSpec
+
+FAULT_CLASSES = (
+    "partition_heal",
+    "byzantine_flood",
+    "slow_lossy",
+    "crash_restart",
+    "catchup_load",
+)
+
+
+def small_specs(seed: int = 1) -> Dict[str, ScenarioSpec]:
+    """Tier-1 shapes: 3 nodes, ≥10 chaos-window ledgers each."""
+    return {
+        "partition_heal": ScenarioSpec(
+            name="partition_heal_small",
+            fault_class="partition_heal",
+            n_nodes=3,
+            threshold=2,  # 2-of-3: the majority side must keep closing
+            seed=seed,
+            # heal at exactly 3 ledgers of lag: within the SCP state
+            # window (send_scp_state_to_peer replays max-3..max), so the
+            # minority node replays the missed slots from peers' state —
+            # the reentrant-externalize ClosePipeline backlog; heal_at is
+            # the backstop if leader-election stalls starve the majority
+            faults=[
+                Partition(
+                    at=0.5, heal_at=12.0, groups=[[0, 1], [2]], heal_lag=3
+                )
+            ],
+            load_backlog_ledgers=2,
+            target_ledgers=14,
+            min_ledgers_per_sec=0.2,
+            max_recovery_ms=15_000,
+            timeout=180.0,
+        ),
+        "byzantine_flood": ScenarioSpec(
+            name="byzantine_flood_small",
+            fault_class="byzantine_flood",
+            n_nodes=3,
+            seed=seed,
+            faults=[
+                ByzantineFlood(
+                    at=0.5, until=7.0, target=0,
+                    envelopes_per_tick=25, txs_per_tick=5, tick=0.4,
+                )
+            ],
+            target_ledgers=14,
+            min_ledgers_per_sec=0.2,
+            timeout=180.0,
+        ),
+        "slow_lossy": ScenarioSpec(
+            name="slow_lossy_small",
+            fault_class="slow_lossy",
+            n_nodes=3,
+            seed=seed,
+            faults=[
+                SlowLossyLinks(
+                    at=0.5,
+                    profile=FaultProfile(
+                        drop=0.005, duplicate=0.005, reorder=0.01,
+                        damage=0.002, latency=0.05,
+                    ),
+                )
+            ],
+            # every fault roll that fires flaps the CONNECTION (MAC
+            # sequence break) and costs a latency-taxed re-handshake, so
+            # liveness degrades by design here; the floor asserts the
+            # network still grinds forward, not that it stays fast
+            doctor_tick=0.5,
+            target_ledgers=14,
+            min_ledgers_per_sec=0.04,
+            timeout=400.0,
+        ),
+        "crash_restart": ScenarioSpec(
+            name="crash_restart_small",
+            fault_class="crash_restart",
+            n_nodes=3,
+            threshold=3,  # 3-of-3: the crash halts consensus outright
+            seed=seed,
+            disk_db=True,
+            faults=[CrashRestart(at=2.0, restart_at=8.0, node=2)],
+            target_ledgers=14,
+            min_ledgers_per_sec=0.1,
+            max_recovery_ms=20_000,
+            timeout=240.0,
+        ),
+        "catchup_load": ScenarioSpec(
+            name="catchup_load_small",
+            fault_class="catchup_load",
+            n_nodes=3,
+            threshold=2,  # majority keeps closing while the lagger is cut
+            seed=seed,
+            clock_mode="real",  # archive get/put are real subprocesses
+            disk_db=True,
+            archives=True,
+            checkpoint_frequency=8,
+            faults=[
+                PartitionUntilCheckpoint(
+                    at=1.0, heal_after_ledger=12, lagger=2
+                )
+            ],
+            load_backlog_ledgers=1,
+            target_ledgers=18,
+            # real-clock scenario: wall time includes archive subprocess
+            # latency; the floor stays conservative
+            min_ledgers_per_sec=0.05,
+            timeout=150.0,
+        ),
+    }
+
+
+def big_specs(seed: int = 1) -> Dict[str, ScenarioSpec]:
+    """Core-and-tier ring scale (-m slow / scenario_liveness_r12 --matrix
+    big): 4-core + 4-tier ring, longer fault windows, bigger floods."""
+    small = small_specs(seed)
+    out: Dict[str, ScenarioSpec] = {}
+    for cls, spec in small.items():
+        big = ScenarioSpec(**{**spec.__dict__})
+        big.name = spec.name.replace("_small", "_big")
+        big.topology = "core_and_tier"
+        big.n_nodes = 4
+        big.tier_n = 4
+        big.threshold = None
+        big.target_ledgers = spec.target_ledgers + 16
+        big.timeout = spec.timeout * 3
+        big.load_txs = 1200
+        if cls == "byzantine_flood":
+            big.faults = [
+                ByzantineFlood(
+                    at=0.5, until=20.0, target=0,
+                    envelopes_per_tick=100, txs_per_tick=20, tick=0.4,
+                )
+            ]
+        elif cls == "partition_heal":
+            # cut the ring AND a core node off the rest
+            big.faults = [
+                Partition(
+                    at=0.5, heal_at=4.0,
+                    groups=[[0, 1, 2], [3, 4, 5, 6, 7]],
+                )
+            ]
+            big.max_recovery_ms = 30_000
+        elif cls == "crash_restart":
+            # 8-node shape keeps BFT majority; crash a TIER node so ring
+            # consensus must route around it, then recover on restart
+            big.faults = [CrashRestart(at=2.0, restart_at=10.0, node=5)]
+            big.threshold = None
+            big.max_recovery_ms = 40_000
+        elif cls == "catchup_load":
+            big.faults = [
+                PartitionUntilCheckpoint(
+                    at=1.0, heal_after_ledger=20, lagger=7
+                )
+            ]
+            big.target_ledgers = 26
+        out[cls] = big
+    return out
+
+
+def run_matrix(
+    matrix: str = "small",
+    only: Optional[List[str]] = None,
+    seed: int = 1,
+    workdir: Optional[str] = None,
+) -> List[ScenarioResult]:
+    specs = small_specs(seed) if matrix == "small" else big_specs(seed)
+    results = []
+    for cls in FAULT_CLASSES:
+        if only and cls not in only:
+            continue
+        results.append(Scenario(specs[cls], workdir=workdir).run())
+    return results

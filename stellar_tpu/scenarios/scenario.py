@@ -1,0 +1,360 @@
+"""Scenario — one declarative chaos run: topology × load × fault program
+× liveness scoreboard.
+
+The runner composes a multi-node Simulation (core mesh or core-and-tier
+ring), streams LoadGenerator traffic through it, arms the fault program on
+the shared clock, and cranks until the liveness target (or the timeout)
+while tracking recovery from heals/restarts.  Every run:
+
+- runs the invariant plane all-on (get_test_config default) and FAILS on
+  any accepted-ledger violation;
+- asserts the surviving nodes agree on the chain;
+- emits one LivenessScoreboard, with a deterministic digest for
+  VIRTUAL_TIME scenarios (same topology + seed + program ⇒ same digest —
+  tests/test_scenarios.py pins the replay);
+- enforces the spec's liveness floors (ledgers/sec, recovery ms).
+
+Clock modes: chaos scenarios default to VIRTUAL_TIME (deterministic,
+seeded).  Catchup-under-load runs REAL_TIME like the history suite — the
+archive get/put commands are real subprocesses whose completion the
+virtual clock would leap past.
+"""
+
+from __future__ import annotations
+
+import shutil
+import tempfile
+from dataclasses import dataclass
+from typing import List, Optional
+
+from ..simulation import LoadGenerator, Simulation, topologies
+from ..simulation.simulation import OVER_LOOPBACK
+from ..tx.testutils import get_test_config
+from ..util import REAL_TIME, VIRTUAL_TIME, VirtualClock, VirtualTimer, xlog
+from ..xdr.scp import SCPQuorumSet
+from .faults import Fault
+from .scoreboard import LivenessScoreboard, snapshot
+
+log = xlog.logger("Scenario")
+
+# scenario node instance numbers start high so tmp/bucket dirs never
+# collide with the unit suites' get_test_config(0..n) apps
+_INSTANCE_BASE = 9100
+
+
+@dataclass
+class ScenarioSpec:
+    name: str
+    fault_class: str
+    faults: List[Fault]
+    n_nodes: int = 3
+    threshold: Optional[int] = None  # None = BFT majority
+    topology: str = "core"  # "core" | "core_and_tier"
+    tier_n: int = 0
+    clock_mode: str = "virtual"  # "virtual" | "real"
+    seed: int = 1
+    # load (streams through node `load_target` for the whole run)
+    load_accounts: int = 6
+    load_txs: int = 400
+    load_rate: int = 40
+    load_backlog_ledgers: int = 0
+    load_target: int = 0
+    # liveness target + floors
+    target_ledgers: int = 12  # absolute min LCL across nodes at the end
+    stabilize_ledgers: int = 2
+    timeout: float = 300.0
+    min_ledgers_per_sec: float = 0.0
+    max_recovery_ms: Optional[float] = None
+    # infrastructure
+    disk_db: bool = False  # crash/restart needs on-disk sqlite
+    archives: bool = False  # catchup needs a history archive
+    checkpoint_frequency: int = 8
+    doctor_tick: float = 1.0
+
+
+@dataclass
+class ScenarioResult:
+    name: str
+    ok: bool
+    failures: List[str]
+    scoreboard: LivenessScoreboard
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "ok": self.ok,
+            "failures": self.failures,
+            "scoreboard": self.scoreboard.to_dict(),
+        }
+
+
+class Scenario:
+    def __init__(self, spec: ScenarioSpec, workdir: Optional[str] = None):
+        self.spec = spec
+        self.workdir = workdir
+        self._own_workdir = False
+        self.sim: Optional[Simulation] = None
+        self.node_keys: List = []
+        self.loadgen: Optional[LoadGenerator] = None
+        self.done = False
+        self._fault_timers: List[VirtualTimer] = []
+        self._doctor_timer: Optional[VirtualTimer] = None
+        self._armed_at = 0.0
+        self._notes: List[str] = []
+        # recovery bookkeeping (heals/restarts stamp the start; the crank
+        # predicate stamps the end at the first agreed post-event close)
+        self._expected_recoveries = 0
+        self._recovery_t0: Optional[float] = None
+        self._recovery_from_lcl = 0
+        self._recoveries: List[float] = []
+
+    # -- fault-program surface ----------------------------------------------
+    def note(self, msg: str) -> None:
+        log.info("[%s] %s", self.spec.name, msg)
+        self._notes.append(msg)
+
+    def elapsed(self) -> float:
+        return self.sim.clock.now() - self._armed_at
+
+    def elapsed_since_arm(self) -> float:
+        return self.elapsed()
+
+    def mark_recovery_start(self) -> None:
+        self._recovery_t0 = self.sim.clock.now()
+        self._recovery_from_lcl = max(
+            (
+                app.ledger_manager.get_last_closed_ledger_num()
+                for app in self.sim.nodes.values()
+            ),
+            default=0,
+        )
+
+    # -- build ---------------------------------------------------------------
+    def _cfg(self, i: int):
+        cfg = get_test_config(_INSTANCE_BASE + i)
+        cfg.MANUAL_CLOSE = False
+        cfg.ARTIFICIALLY_ACCELERATE_TIME_FOR_TESTING = True
+        if self.spec.disk_db or self.spec.archives:
+            cfg.DATABASE = f"sqlite3://{self.workdir}/node{i}.db"
+        if self.spec.archives:
+            cfg.CHECKPOINT_FREQUENCY = self.spec.checkpoint_frequency
+            archive = f"{self.workdir}/archive"
+            spec = {"get": f"cp {archive}/{{0}} {{1}}"}
+            if i == 0:  # one writer avoids concurrent cp races
+                spec["put"] = f"cp {{0}} {archive}/{{1}}"
+                spec["mkdir"] = f"mkdir -p {archive}/{{0}}"
+            cfg.HISTORY = {"scenario": spec}
+        return cfg
+
+    def _build(self) -> None:
+        spec = self.spec
+        if (spec.disk_db or spec.archives) and self.workdir is None:
+            self.workdir = tempfile.mkdtemp(prefix="stellar-tpu-scn-")
+            self._own_workdir = True
+        if self.spec.archives:
+            import os
+
+            os.makedirs(f"{self.workdir}/archive", exist_ok=True)
+        mode = VIRTUAL_TIME if spec.clock_mode == "virtual" else REAL_TIME
+        clock = VirtualClock(mode)
+        if spec.topology == "core_and_tier":
+            sim = topologies.core_and_tier(
+                core_n=spec.n_nodes,
+                tier_n=spec.tier_n,
+                clock=clock,
+                cfg_factory=self._cfg,
+            )
+            self.node_keys = sim.topology_keys
+        else:
+            sim = Simulation(OVER_LOOPBACK, clock)
+            from ..crypto.keys import SecretKey
+
+            keys = [
+                SecretKey.pseudo_random_for_testing(i + 1)
+                for i in range(spec.n_nodes)
+            ]
+            threshold = (
+                spec.threshold
+                if spec.threshold is not None
+                else spec.n_nodes - (spec.n_nodes - 1) // 3
+            )
+            qset = SCPQuorumSet(
+                threshold, [k.get_public_key() for k in keys], []
+            )
+            for i, k in enumerate(keys):
+                sim.add_node(k, qset, cfg=self._cfg(i))
+            for i in range(len(keys)):
+                for j in range(i + 1, len(keys)):
+                    sim.add_pending_connection(keys[i], keys[j])
+            self.node_keys = keys
+        sim.set_fault_seed(spec.seed)
+        self.sim = sim
+
+    # -- run ------------------------------------------------------------------
+    def run(self) -> ScenarioResult:
+        spec = self.spec
+        self._build()
+        sim = self.sim
+        failures: List[str] = []
+        try:
+            sim.start_all_nodes()
+            ok = sim.crank_until(
+                lambda: sim.have_all_externalized(spec.stabilize_ledgers),
+                spec.timeout / 3,
+            )
+            if not ok:
+                failures.append(
+                    "stabilization stuck at %s" % sim.ledger_nums()
+                )
+                sb = LivenessScoreboard(
+                    scenario=spec.name, fault_class=spec.fault_class,
+                    seed=spec.seed, clock_mode=spec.clock_mode,
+                )
+                return ScenarioResult(spec.name, False, failures, sb)
+
+            # chaos window opens: snapshot, arm load + faults + doctor
+            before = snapshot(sim)
+            self._armed_at = sim.clock.now()
+            self.loadgen = LoadGenerator(seed=spec.seed)
+            self.loadgen.generate_load(
+                sim.nodes[self._raw(spec.load_target)],
+                spec.load_accounts,
+                spec.load_txs,
+                spec.load_rate,
+                backlog_ledgers=spec.load_backlog_ledgers,
+            )
+            for f in spec.faults:
+                marks_recovery = (
+                    getattr(f, "heal_at", None) is not None
+                    or type(f).__name__
+                    in ("CrashRestart", "PartitionUntilCheckpoint")
+                )
+                if marks_recovery:
+                    self._expected_recoveries += 1
+                f.arm(self)
+            self._doctor(first=True)
+
+            ok = sim.crank_until(self._target_reached, spec.timeout)
+            self.done = True
+            if not ok:
+                failures.append(
+                    "liveness target %d not reached in %.0fs: lcls=%s,"
+                    " recoveries=%d/%d"
+                    % (
+                        spec.target_ledgers,
+                        spec.timeout,
+                        sim.ledger_nums(),
+                        len(self._recoveries),
+                        self._expected_recoveries,
+                    )
+                )
+
+            after = snapshot(sim)
+            sb = LivenessScoreboard.from_snapshots(
+                sim,
+                before,
+                after,
+                scenario=spec.name,
+                fault_class=spec.fault_class,
+                seed=spec.seed,
+                clock_mode=spec.clock_mode,
+            )
+            if self._recoveries:
+                sb.recovery_ms = round(max(self._recoveries), 1)
+            sb.notes = list(self._notes)
+
+            # -- verdicts ---------------------------------------------------
+            if sb.invariant_violations:
+                failures.append(
+                    "%d ledger-invariant violation(s) under chaos"
+                    % sb.invariant_violations
+                )
+            if not sb.ledgers_agree:
+                failures.append("surviving nodes disagree on the chain")
+            if spec.min_ledgers_per_sec and (
+                sb.ledgers_per_sec < spec.min_ledgers_per_sec
+            ):
+                failures.append(
+                    "liveness floor miss: %.3f < %.3f ledgers/sec"
+                    % (sb.ledgers_per_sec, spec.min_ledgers_per_sec)
+                )
+            if spec.max_recovery_ms is not None and (
+                sb.recovery_ms is None
+                or sb.recovery_ms > spec.max_recovery_ms
+            ):
+                failures.append(
+                    "recovery floor miss: %s ms (max %.0f)"
+                    % (sb.recovery_ms, spec.max_recovery_ms)
+                )
+            for f in spec.faults:
+                checker = getattr(f, "assert_cache_unpolluted", None)
+                if checker is not None:
+                    try:
+                        checked = checker()
+                        self._notes.append(
+                            "verify cache clean across %d flooded"
+                            " invalid-sig envelopes" % checked
+                        )
+                    except AssertionError as e:
+                        failures.append(str(e))
+                fetchers = getattr(f, "n_envelopes", None)
+                if fetchers:
+                    # the fetch plane must not have wedged on made-up
+                    # hashes (the eager-reject defense the flood attacks)
+                    for raw, app in sim.nodes.items():
+                        info = app.herder.pending_envelopes.dump_info()
+                        wedged = sum(info["fetching"].values())
+                        if wedged:
+                            failures.append(
+                                "node %s wedged %d envelopes in the fetch"
+                                " plane under flood" % (raw.hex()[:8], wedged)
+                            )
+            sb.notes = list(self._notes)
+            return ScenarioResult(spec.name, not failures, failures, sb)
+        finally:
+            self.done = True
+            for t in self._fault_timers:
+                t.cancel()
+            if self._doctor_timer is not None:
+                self._doctor_timer.cancel()
+            if self.loadgen is not None:
+                self.loadgen.stop()
+            sim.stop_all_nodes()
+            sim.clock.shutdown()
+            if self._own_workdir:
+                shutil.rmtree(self.workdir, ignore_errors=True)
+
+    # -- internals ------------------------------------------------------------
+    def _raw(self, idx: int) -> bytes:
+        return Simulation._raw_key(self.node_keys[idx])
+
+    def _doctor(self, first: bool = False) -> None:
+        """Link doctor tick: re-establish flapped/expected links (lossy
+        links kill connections via MAC-sequence breaks; restarts rejoin
+        here too), then re-arm."""
+        if self.done:
+            return
+        if not first:
+            self.sim.ensure_links()
+        if self._doctor_timer is None:
+            self._doctor_timer = VirtualTimer(self.sim.clock)
+        self._doctor_timer.expires_from_now(self.spec.doctor_tick)
+        self._doctor_timer.async_wait(self._doctor)
+
+    def _target_reached(self) -> bool:
+        sim = self.sim
+        lcls = sim.ledger_nums()
+        if not lcls:
+            return False
+        # recovery stamp: first moment every surviving node moved past the
+        # pre-heal high-water mark in lockstep
+        if self._recovery_t0 is not None:
+            if min(lcls) > self._recovery_from_lcl and min(lcls) == max(lcls):
+                self._recoveries.append(
+                    (sim.clock.now() - self._recovery_t0) * 1000.0
+                )
+                self._recovery_t0 = None
+        return (
+            min(lcls) >= self.spec.target_ledgers
+            and len(self._recoveries) >= self._expected_recoveries
+        )

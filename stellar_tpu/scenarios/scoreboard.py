@@ -1,0 +1,195 @@
+"""LivenessScoreboard — what the chaos plane measures.
+
+A scenario is only as good as its verdict: every run emits one scoreboard
+covering consensus liveness (ledgers closed / wall time, nomination and
+ballot rounds burned), the flood plane (fan-out, strict-gate fast
+rejects), the close pipeline's overlap stats, recovery time after a
+heal/restart, and the invariant plane's violation count.  The scoreboard
+is built from COUNTER DELTAS between two snapshots, so the stabilization
+phase before the fault program arms never pollutes the chaos window.
+
+``digest()`` is the deterministic-replay oracle (ISSUE r12 satellite):
+same topology + seed + fault program ⇒ identical digest across runs.  It
+deliberately covers only clock-deterministic fields — worker-thread
+timing artifacts (pipeline joined_warm, overlap ms) are reported but
+excluded.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..crypto import sha256
+
+
+_PIPE_KEYS = (
+    "dispatched", "joined", "joined_warm", "quarantined",
+    "overlap_hidden_ms", "backlog_drains",
+)
+
+
+def _node_counters(app) -> Dict[str, int]:
+    h = app.herder
+    om = app.overlay_manager
+    inv = getattr(app, "invariants", None)
+    pipe = getattr(app, "close_pipeline", None)
+    pipe_stats = pipe.stats() if pipe is not None else {}
+    out = {
+        "pipe." + k: pipe_stats.get(k, 0) for k in _PIPE_KEYS
+    }
+    out.update({
+        "externalized": h.m_value_externalize.count if h else 0,
+        "nomination_rounds": h.n_nomination_rounds if h else 0,
+        "ballot_rounds": h.n_ballot_rounds if h else 0,
+        "envelopes_emitted": h.m_envelope_emit.count if h else 0,
+        "envelopes_received": h.m_envelope_receive.count if h else 0,
+        "envelopes_invalid_sig": h.m_envelope_invalidsig.count if h else 0,
+        "flood_fanout": om.floodgate.n_sent if om else 0,
+        "scp_batch_rejected": om.m_scp_batch_rejected.count if om else 0,
+        "invariant_violations": inv.total_violations if inv else 0,
+    })
+    return out
+
+
+@dataclass
+class Snapshot:
+    at: float
+    lcls: Dict[str, int]
+    counters: Dict[str, Dict[str, int]]  # node hex prefix -> counters
+
+
+def snapshot(sim) -> Snapshot:
+    return Snapshot(
+        at=sim.clock.now(),
+        lcls={
+            raw.hex()[:8]: app.ledger_manager.get_last_closed_ledger_num()
+            for raw, app in sim.nodes.items()
+        },
+        counters={
+            raw.hex()[:8]: _node_counters(app)
+            for raw, app in sim.nodes.items()
+        },
+    )
+
+
+@dataclass
+class LivenessScoreboard:
+    scenario: str = ""
+    fault_class: str = ""
+    seed: int = 0
+    clock_mode: str = "virtual"
+    # liveness
+    ledgers_closed: int = 0  # min across surviving nodes, chaos window
+    wall_seconds: float = 0.0
+    ledgers_per_sec: float = 0.0
+    nomination_rounds: int = 0
+    ballot_rounds: int = 0
+    # flood plane
+    envelopes_emitted: int = 0
+    envelopes_received: int = 0
+    flood_fanout: int = 0
+    fast_rejects: int = 0  # invalid-sig envelopes rejected (eager + batch)
+    fast_reject_rate_per_sec: float = 0.0
+    # recovery
+    recovery_ms: Optional[float] = None  # heal/restart -> next agreed close
+    # correctness
+    invariant_violations: int = 0
+    ledgers_agree: bool = True
+    final_lcls: Dict[str, int] = field(default_factory=dict)
+    final_hash: str = ""  # ledger hash at the lowest common sequence
+    # close pipeline (reported, excluded from digest: thread timing)
+    pipeline: Dict[str, float] = field(default_factory=dict)
+    notes: List[str] = field(default_factory=list)
+
+    @classmethod
+    def from_snapshots(cls, sim, before: Snapshot, after: Snapshot, **kw):
+        sb = cls(**kw)
+        sb.wall_seconds = max(1e-9, after.at - before.at)
+        deltas = []
+        for node, c1 in after.counters.items():
+            c0 = before.counters.get(node, {})
+            # a restarted validator is a fresh Application: its counters
+            # reset to zero mid-window, so a value below the snapshot
+            # means "count since restart" — use it whole, not the
+            # (negative) difference
+            deltas.append(
+                {
+                    k: (c1[k] - c0.get(k, 0)) if c1[k] >= c0.get(k, 0)
+                    else c1[k]
+                    for k in c1
+                }
+            )
+        closed = [
+            after.lcls[n] - before.lcls.get(n, 0)
+            for n in after.lcls
+        ]
+        sb.ledgers_closed = min(closed) if closed else 0
+        sb.ledgers_per_sec = round(sb.ledgers_closed / sb.wall_seconds, 3)
+        for d in deltas:
+            sb.nomination_rounds += d["nomination_rounds"]
+            sb.ballot_rounds += d["ballot_rounds"]
+            sb.envelopes_emitted += d["envelopes_emitted"]
+            sb.envelopes_received += d["envelopes_received"]
+            sb.flood_fanout += d["flood_fanout"]
+            sb.fast_rejects += d["envelopes_invalid_sig"]
+            sb.invariant_violations += d["invariant_violations"]
+        sb.fast_reject_rate_per_sec = round(
+            sb.fast_rejects / sb.wall_seconds, 2
+        )
+        sb.final_lcls = dict(after.lcls)
+        sb.ledgers_agree = sim.all_ledgers_agree()
+        if sb.ledgers_agree and sim.nodes:
+            from ..ledger.headerframe import LedgerHeaderFrame
+
+            min_seq = min(
+                app.ledger_manager.get_last_closed_ledger_num()
+                for app in sim.nodes.values()
+            )
+            any_app = next(iter(sim.nodes.values()))
+            f = LedgerHeaderFrame.load_by_sequence(any_app.database, min_seq)
+            if f is not None:
+                sb.final_hash = f.get_hash().hex()
+        # pipeline stats ride the same snapshot-delta discipline as the
+        # other counters: stabilization-phase dispatches never count
+        # toward the chaos window
+        sb.pipeline = {
+            k: round(sum(d.get("pipe." + k, 0) for d in deltas), 1)
+            for k in _PIPE_KEYS
+        }
+        return sb
+
+    def to_dict(self) -> dict:
+        d = dict(self.__dict__)
+        d["digest"] = self.digest()
+        return d
+
+    def digest(self) -> str:
+        """Deterministic-replay oracle: clock-deterministic fields only.
+        Virtual-clock scenarios must reproduce this exactly for the same
+        (topology, seed, fault program); real-clock scenarios report it
+        for the record but rates/wall-time fields stay out regardless."""
+        stable = {
+            "scenario": self.scenario,
+            "seed": self.seed,
+            "ledgers_closed": self.ledgers_closed,
+            "final_lcls": self.final_lcls,
+            "final_hash": self.final_hash,
+            "ledgers_agree": self.ledgers_agree,
+            "invariant_violations": self.invariant_violations,
+        }
+        if self.clock_mode == "virtual":
+            # deterministic under VIRTUAL_TIME only: counters below move
+            # with message/crank interleaving, which the virtual clock
+            # replays exactly but a real clock does not
+            stable.update(
+                wall_seconds=round(self.wall_seconds, 6),
+                nomination_rounds=self.nomination_rounds,
+                ballot_rounds=self.ballot_rounds,
+                fast_rejects=self.fast_rejects,
+                recovery_ms=self.recovery_ms,
+            )
+        return sha256(
+            json.dumps(stable, sort_keys=True).encode()
+        ).hex()[:32]

@@ -54,6 +54,7 @@ class LoadGenerator:
         self.rate = 10
         self.auto_rate = False
         self.mix = "payments"
+        self.backlog_ledgers = 0
         self._last_second = -1
         self._root_seq = 0
         self._running = False
@@ -62,6 +63,7 @@ class LoadGenerator:
     def generate_load(
         self, app, n_accounts: int, n_txs: int, rate: int,
         auto_rate: bool = False, mix: str = "payments",
+        backlog_ledgers: int = 0,
     ) -> None:
         """(CommandHandler 'generateload') queue work and start stepping.
 
@@ -73,17 +75,37 @@ class LoadGenerator:
         ``mix='full'`` adds the reference's richer random-tx shapes
         (LoadGenerator.cpp:664-684 createRandomTransaction): trustline
         creation, credit payments along trustlines, and market-maker
-        offers, alongside native payments."""
+        offers, alongside native payments.
+
+        ``backlog_ledgers`` is the >1-close backlog shape (ROADMAP #3's
+        remaining leg): each step tops the target herder's pending-tx
+        queue up to ``backlog_ledgers × maxTxSetSize`` (rate permitting
+        nothing — the backlog goal overrides the step budget), so every
+        close proposes a full set with MORE work already queued behind it.
+        Combined with a partition/heal or catchup replay, the externalized
+        backlog then drains through ClosePipeline at dispatch-ahead depth
+        ≥ 2 with non-empty prewarm candidates — the steady-state shape the
+        pipeline was built for."""
         self.pending_accounts += n_accounts
         self.pending_txs += n_txs
         self.rate = max(1, rate)
         self.auto_rate = auto_rate
         self.mix = mix
+        self.backlog_ledgers = backlog_ledgers
         if not self._running:
             self._running = True
             if self.timer is None:
                 self.timer = VirtualTimer(app.clock)
             self._schedule(app)
+
+    def stop(self) -> None:
+        """Abandon remaining work and cancel the step timer (scenario
+        teardown: a dead app's clock must not fire loadgen steps)."""
+        self.pending_accounts = 0
+        self.pending_txs = 0
+        self._running = False
+        if self.timer is not None:
+            self.timer.cancel()
 
     # -- auto-rate calibration (LoadGenerator.cpp:172-199, 334-402) ---------
     def _maybe_adjust_rate(self, target: float, actual: float,
@@ -152,6 +174,14 @@ class LoadGenerator:
         if self.auto_rate:
             self._auto_adjust(app)
         budget = max(1, int(self.rate * STEP_SECONDS))
+        if self.backlog_ledgers > 0:
+            # >1-close backlog shape: keep backlog_ledgers ledgers' worth
+            # of transactions pending in the herder at all times
+            want = (
+                self.backlog_ledgers
+                * app.ledger_manager.get_max_tx_set_size()
+            )
+            budget = max(budget, want - self._herder_pending(app))
         submitted = 0
         # only count work off the pending totals when the herder accepted
         # it; a rejection (queue full, fee check) is retried next step
@@ -169,6 +199,14 @@ class LoadGenerator:
 
     def _have_live_accounts(self) -> bool:
         return sum(1 for a in self.accounts if a.created) >= 2
+
+    @staticmethod
+    def _herder_pending(app) -> int:
+        return sum(
+            len(txmap.transactions)
+            for gen in app.herder.received_transactions
+            for txmap in gen.values()
+        )
 
     # -- tx builders --------------------------------------------------------
     def _root(self, app):

@@ -6,6 +6,15 @@ cluster": every node is a full Application sharing a single virtual clock,
 connected over LoopbackPeer pairs (or real TCP sockets on localhost), and
 ``crank_until`` advances the one clock until the predicate holds — fully
 deterministic in VIRTUAL_TIME mode.
+
+The chaos plane (stellar_tpu/scenarios/) drives the fault surface below:
+``partition``/``heal`` sever and re-establish loopback links between node
+groups, ``crash_node``/``restart_node`` take a validator down and bring it
+back on its on-disk state, and ``ensure_links`` is the link doctor — in
+loopback mode nothing reconnects by itself (there is no address book
+dial-out), so lossy links that flap (any post-handshake drop/damage costs
+the connection, see overlay/loopback.py FaultProfile) are re-established
+here, carrying the scheduled fault profile onto the fresh pair.
 """
 
 from __future__ import annotations
@@ -15,6 +24,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 from ..crypto.keys import SecretKey
 from ..main.application import Application
 from ..overlay import LoopbackPeerConnection, PeerRecord
+from ..overlay.loopback import FaultProfile
 from ..tx.testutils import get_test_config
 from ..util import VIRTUAL_TIME, VirtualClock, xlog
 from ..xdr.scp import SCPQuorumSet
@@ -33,7 +43,21 @@ class Simulation:
         self.clock = clock or VirtualClock(VIRTUAL_TIME)
         self.nodes: Dict[bytes, Application] = {}  # pubkey raw -> app
         self.pending_connections: List[Tuple[bytes, bytes]] = []
-        self.connections: List[LoopbackPeerConnection] = []
+        # live loopback pairs WITH their endpoints — one record per
+        # connection so the fault surface can never misattribute a
+        # profile or sever the wrong link
+        self._live: List[Tuple[LoopbackPeerConnection, Tuple[bytes, bytes]]] = []
+        # expected topology links (unordered pairs) — the link doctor's
+        # target state; populated by add_connection/add_pending_connection
+        self.links: List[Tuple[bytes, bytes]] = []
+        # active partition: list of frozensets of node keys; links crossing
+        # group boundaries stay severed until heal()
+        self._partition_groups: List[frozenset] = []
+        # per-link fault profile + deterministic reseed bookkeeping
+        self._link_profiles: Dict[frozenset, FaultProfile] = {}
+        self._fault_seed = 0
+        self._link_flaps: Dict[frozenset, int] = {}
+        self._crashed: Dict[bytes, Tuple[SecretKey, object]] = {}
         self._next_instance = 0
 
     # -- building -----------------------------------------------------------
@@ -78,13 +102,20 @@ class Simulation:
     def add_pending_connection(self, a, b) -> None:
         self.pending_connections.append((self._raw_key(a), self._raw_key(b)))
 
+    def _note_link(self, ia: bytes, ib: bytes) -> None:
+        if (ia, ib) not in self.links and (ib, ia) not in self.links:
+            self.links.append((ia, ib))
+
     def add_connection(self, a, b) -> None:
         """Connect two running nodes now."""
         ia, ib = self._raw_key(a), self._raw_key(b)
+        self._note_link(ia, ib)
         if self.mode == OVER_LOOPBACK:
-            self.connections.append(
-                LoopbackPeerConnection(self.nodes[ia], self.nodes[ib])
-            )
+            conn = LoopbackPeerConnection(self.nodes[ia], self.nodes[ib])
+            self._live.append((conn, (ia, ib)))
+            profile = self._link_profiles.get(frozenset((ia, ib)))
+            if profile is not None:
+                self._arm_profile(conn, ia, ib, profile)
         else:
             target = self.nodes[ib]
             self.nodes[ia].overlay_manager.connect_to(
@@ -102,6 +133,150 @@ class Simulation:
     def stop_all_nodes(self) -> None:
         for app in self.nodes.values():
             app.graceful_stop()
+
+    # -- chaos-plane fault surface (stellar_tpu/scenarios/) -----------------
+    def set_fault_seed(self, seed: int) -> None:
+        """Root seed for every fault-profile RNG this simulation arms —
+        same topology + seed + fault program ⇒ identical fault rolls
+        (the chaos plane's deterministic-replay contract)."""
+        self._fault_seed = int(seed)
+
+    def _arm_profile(
+        self, conn: LoopbackPeerConnection, ia: bytes, ib: bytes,
+        profile: FaultProfile,
+    ) -> None:
+        """Apply a fault profile to both sides of a live loopback pair,
+        reseeding each side from (root seed, link identity, side, flap
+        count) so re-runs roll identical faults and reconnects after a
+        flap roll fresh-but-deterministic sequences."""
+        from ..crypto import sha256
+
+        link = frozenset((ia, ib))
+        flap = self._link_flaps.get(link, 0)
+        # stable digest, NOT hash(): bytes hashing is salted per process
+        # (PYTHONHASHSEED) and the replay contract is cross-process
+        base = int.from_bytes(
+            sha256(
+                self._fault_seed.to_bytes(8, "big", signed=True)
+                + min(ia, ib)
+                + max(ia, ib)
+                + flap.to_bytes(4, "big")
+            )[:8],
+            "big",
+        )
+        profile.apply(conn.initiator, seed=base ^ 0x5EED0001)
+        profile.apply(conn.acceptor, seed=base ^ 0x5EED0002)
+
+    def set_link_faults(self, profile: FaultProfile, a=None, b=None) -> None:
+        """Install `profile` on the link (a, b), or on EVERY link when both
+        are None; live connections are armed now, reconnections (doctor,
+        heal) re-arm automatically."""
+        assert self.mode == OVER_LOOPBACK, "fault knobs ride loopback pairs"
+        targets = (
+            [frozenset(l) for l in self.links]
+            if a is None and b is None
+            else [frozenset((self._raw_key(a), self._raw_key(b)))]
+        )
+        for link in targets:
+            self._link_profiles[link] = profile
+        for conn, (ia, ib) in self._live:
+            if frozenset((ia, ib)) in self._link_profiles and not (
+                conn.initiator._closed and conn.acceptor._closed
+            ):
+                self._arm_profile(
+                    conn, ia, ib, self._link_profiles[frozenset((ia, ib))]
+                )
+
+    def _sever_connection(self, conn: LoopbackPeerConnection) -> None:
+        for peer in (conn.initiator, conn.acceptor):
+            if not peer._closed:
+                peer.drop()
+
+    def link_is_up(self, a, b) -> bool:
+        ia, ib = self._raw_key(a), self._raw_key(b)
+        for conn, (ca, cb) in self._live:
+            if {ca, cb} == {ia, ib} and (
+                conn.initiator.is_authenticated()
+                and conn.acceptor.is_authenticated()
+            ):
+                return True
+        return False
+
+    def _crosses_partition(self, ia: bytes, ib: bytes) -> bool:
+        for g in self._partition_groups:
+            if (ia in g) != (ib in g):
+                return True
+        return False
+
+    def partition(self, *groups) -> None:
+        """Sever every link crossing the given node groups (each group a
+        list of keys); the split stays enforced (the doctor will not
+        re-establish crossing links) until ``heal``."""
+        self._partition_groups = [
+            frozenset(self._raw_key(k) for k in g) for g in groups
+        ]
+        for conn, (ia, ib) in self._live:
+            if self._crosses_partition(ia, ib):
+                self._sever_connection(conn)
+
+    def heal(self) -> None:
+        """Lift the partition and re-establish the severed links now."""
+        self._partition_groups = []
+        self.ensure_links()
+
+    def ensure_links(self) -> None:
+        """The link doctor: re-establish every expected-topology link whose
+        loopback pair is gone (flapped lossy link, healed partition,
+        restarted validator), carrying the link's fault profile onto the
+        fresh pair.  Links crossing an active partition stay down."""
+        if self.mode != OVER_LOOPBACK:
+            return
+        # compact dead pairs first so link_is_up scans stay honest
+        self._live = [
+            (c, ends)
+            for c, ends in self._live
+            if not (c.initiator._closed or c.acceptor._closed)
+        ]
+        for ia, ib in self.links:
+            if ia in self._crashed or ib in self._crashed:
+                continue
+            if ia not in self.nodes or ib not in self.nodes:
+                continue
+            if self._crosses_partition(ia, ib):
+                continue
+            if not any({ca, cb} == {ia, ib} for _, (ca, cb) in self._live):
+                self._link_flaps[frozenset((ia, ib))] = (
+                    self._link_flaps.get(frozenset((ia, ib)), 0) + 1
+                )
+                self.add_connection(ia, ib)
+
+    def crash_node(self, key) -> None:
+        """Take a validator down hard: stop its subsystems (timers armed on
+        the shared clock are cancelled — a dead node must not fire closes
+        against a closed DB) and sever its links.  The node's config
+        (pointing at its on-disk DB) is kept for restart_node."""
+        raw = self._raw_key(key)
+        app = self.nodes.pop(raw)
+        secret = app.config.NODE_SEED
+        for conn, (ia, ib) in self._live:
+            if raw in (ia, ib):
+                self._sever_connection(conn)
+        app.graceful_stop()
+        self._crashed[raw] = (secret, app.config)
+        log.info("chaos: crashed node %s", raw.hex()[:8])
+
+    def restart_node(self, key, force_scp: bool = True) -> Application:
+        """Bring a crashed validator back on its on-disk state and rejoin
+        it to the expected topology (the doctor re-links immediately)."""
+        raw = self._raw_key(key)
+        secret, cfg = self._crashed.pop(raw)
+        cfg.FORCE_SCP = force_scp
+        app = self.add_node(secret, cfg.QUORUM_SET, cfg=cfg, new_db=False,
+                            force_scp=force_scp)
+        app.start()
+        self.ensure_links()
+        log.info("chaos: restarted node %s", raw.hex()[:8])
+        return app
 
     # -- cranking -----------------------------------------------------------
     def crank_all_nodes(self, n: int = 1) -> int:

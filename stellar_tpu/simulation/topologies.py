@@ -95,6 +95,62 @@ def hierarchical_quorum_simplified(
     return sim
 
 
+def core_and_tier(
+    core_n: int = 4,
+    tier_n: int = 4,
+    clock: Optional[VirtualClock] = None,
+    cfg_factory=None,
+) -> Simulation:
+    """Core-and-tier quorum ring (SURVEY §2.11; the chaos plane's default
+    big shape): a fully-meshed core of ``core_n`` validators sharing one
+    BFT-majority quorum set, plus a RING of ``tier_n`` tier-2 validators —
+    each tier node's quorum slice is {threshold 2: [self, ring-successor],
+    inner: core} and its links are its two ring neighbors plus one core
+    node.  Consensus must traverse the ring through the core, so
+    partitions that cut ring chords exercise multi-hop flood relay.
+
+    ``cfg_factory(i)`` (optional) supplies each node's Config — the
+    scenario runner uses it to pin disk DBs / archives; ``i`` counts core
+    nodes first, then tier nodes."""
+    sim = Simulation(OVER_LOOPBACK, clock)
+    ck = _keys(core_n)
+    core_threshold = core_n - (core_n - 1) // 3
+    core_qset = SCPQuorumSet(
+        core_threshold, [x.get_public_key() for x in ck], []
+    )
+    for i, x in enumerate(ck):
+        sim.add_node(
+            x, core_qset,
+            cfg=cfg_factory(i) if cfg_factory is not None else None,
+        )
+    for i in range(core_n):
+        for j in range(i + 1, core_n):
+            sim.add_pending_connection(ck[i], ck[j])
+    tk = [
+        SecretKey.pseudo_random_for_testing(300 + i) for i in range(tier_n)
+    ]
+    for i, x in enumerate(tk):
+        succ = tk[(i + 1) % tier_n]
+        qset = SCPQuorumSet(
+            2,
+            [x.get_public_key(), succ.get_public_key()],
+            [core_qset],
+        )
+        sim.add_node(
+            x, qset,
+            cfg=(
+                cfg_factory(core_n + i) if cfg_factory is not None else None
+            ),
+        )
+    for i in range(tier_n):
+        sim.add_pending_connection(tk[i], tk[(i + 1) % tier_n])
+        sim.add_pending_connection(tk[i], ck[i % core_n])
+    # remember construction order for callers that index nodes (the
+    # scenario runner's fault programs name nodes by index)
+    sim.topology_keys = ck + tk
+    return sim
+
+
 def hierarchical_quorum(
     n_branches: int = 2,
     clock: Optional[VirtualClock] = None,

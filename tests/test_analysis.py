@@ -82,6 +82,24 @@ def test_rule_fixtures(rule, pos, neg, n_pos):
     assert not rn.parse_errors
 
 
+def test_determinism_rule_covers_chaos_plane():
+    """r12: the determinism rule's scope includes simulation/ and
+    scenarios/ — the chaos plane's replay contract (same topology + seed
+    + fault program ⇒ same run) requires seeded rolls and clock-routed
+    time in the harness itself, not just in the consensus planes."""
+    for path in ("scenarios/faults_fixture.py", "simulation/lg_fixture.py"):
+        rp = analyze_source(
+            "import time\n\ndef t():\n    return time.time()\n", path
+        )
+        assert [v.rule for v in rp.violations] == ["determinism"], path
+    # seeded construction stays legal (the fix the rule prescribes)
+    rp = analyze_source(
+        "import random\n_rng = random.Random(7)\n",
+        "scenarios/seeded_fixture.py",
+    )
+    assert not rp.violations
+
+
 def test_fixture_inventory_covers_every_rule():
     """Every registered rule (meta aside) carries fixture coverage — a new
     rule without an executable spec fails here, and >=6 rules are active

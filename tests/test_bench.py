@@ -12,6 +12,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 def run_bench(env_extra, timeout=240, force_cpu=True):
     # ambient BENCH_* knobs (from manual hardware runs) must not leak in
     env = {k: v for k, v in os.environ.items() if not k.startswith("BENCH_")}
+    # the chaos-scenario legs are ~60-90s of multi-node sims — covered by
+    # their own suite (tests/test_scenarios.py) and a direct-call contract
+    # test below, not by every bench contract run
+    env["BENCH_SCENARIOS"] = "0"
     env.update(env_extra)
     code = (
         "import jax; jax.config.update('jax_platforms','cpu');"
@@ -59,6 +63,22 @@ def test_bench_emits_one_json_line():
         assert out["host_stage"] == "native"
     else:
         assert out["host_stage"] == "python"
+
+
+def test_bench_byzantine_flood_leg_direct():
+    """The flood leg (ISSUE r12): all-reject rate reported and the verify
+    cache provably un-polluted — direct call, small fixture."""
+    import bench
+
+    items = bench._scp_envelope_items(64)
+    out = bench.bench_byzantine_flood(reps=1, items=items)
+    assert out["strict_gate_rejects_per_sec"] > 0
+    assert out["n"] == 64
+    assert out["cache_latched_invalid"] == 0
+    from stellar_tpu import native
+
+    if native.load_sighash() is not None:
+        assert out["gate_stage_rejects_per_sec"] > 0
 
 
 def test_bench_relay_down_reports_one_line_and_exits_2():

@@ -93,9 +93,10 @@ class TestSigFlushFuture:
         got = fut.result(timeout=10)
         assert got == be.verify_batch(items)
         assert got[:8] == [True] * 8 and got[8] is False
-        # verdicts latched: a fresh sync batch is all cache hits (the
-        # inner backend is bypassed entirely)
-        assert cache.peek_many(_keys(cache, items)) == got
+        # VALID verdicts latched; the invalid one stays out of the cache
+        # (flood cache-pollution defense, ISSUE r12 — a distinct-invalid
+        # flood must not be able to evict honest entries)
+        assert cache.peek_many(_keys(cache, items)) == [True] * 8 + [None]
 
     def test_all_hit_batch_never_reaches_inner_backend(self):
         calls = []

@@ -169,14 +169,18 @@ class TestVerifyCache:
         assert misses == 1
         assert hits == 1
 
-    def test_negative_results_cached_too(self):
+    def test_negative_results_never_cached(self):
+        """Invalid-sig verdicts stay OUT of the bounded LRU (ISSUE r12
+        byzantine-flood defense): a flood of distinct invalid items must
+        not evict honest entries.  Re-verification is pure and cheap."""
         sk = SecretKey.pseudo_random_for_testing(3)
         bad_sig = b"\x01" * 64
         PubKeyUtils.clear_verify_sig_cache()
         assert not PubKeyUtils.verify_sig(sk.get_public_key(), bad_sig, b"m")
         assert not PubKeyUtils.verify_sig(sk.get_public_key(), bad_sig, b"m")
         hits, misses = PubKeyUtils.flush_verify_sig_cache_counts()
-        assert (hits, misses) == (1, 1)
+        assert (hits, misses) == (0, 2)
+        assert len(verify_cache()) == 0
 
 
 class TestSigBackendCpu:
@@ -196,11 +200,12 @@ class TestSigBackendCpu:
             items.append((sk.public_raw, msg, sig))
         verify_cache().clear()
         assert backend.verify_batch(items) == expected
-        # second run: all from cache
+        # second run: the 5 valid verdicts come from the cache; the 3
+        # invalid ones re-verify (never latched — flood-pollution defense)
         verify_cache().flush_counts()
         assert backend.verify_batch(items) == expected
         hits, misses = verify_cache().flush_counts()
-        assert hits == 8 and misses == 0
+        assert hits == 5 and misses == 0
 
 
 class TestTpuBackendCutover:

@@ -1,0 +1,155 @@
+"""Chaos-plane tests (stellar_tpu/scenarios/) — the ISSUE r12 acceptance
+matrix: 5 fault classes, small shapes each closing ≥10 ledgers under
+tier-1 with the invariant plane all-on, a deterministic seeded replay for
+the virtual-clock classes, and the ClosePipeline >1-close backlog
+exercised under simulation load (ROADMAP #3's remaining leg).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from stellar_tpu.crypto.keys import verify_cache
+from stellar_tpu.scenarios import run_matrix
+from stellar_tpu.scenarios.matrix import small_specs
+
+
+def run_class(cls):
+    # the global verify cache persists across tests in one process; a
+    # scenario's digest is defined against a cold cache (the replay
+    # contract is same-preconditions ⇒ same run)
+    verify_cache().clear()
+    r = run_matrix(only=[cls])[0]
+    assert r.ok, r.failures
+    sb = r.scoreboard
+    assert sb.ledgers_closed >= 10, sb.to_dict()
+    assert sb.invariant_violations == 0
+    assert sb.ledgers_agree and sb.final_hash
+    assert sb.nomination_rounds > 0 and sb.ballot_rounds > 0
+    assert sb.flood_fanout > 0  # consensus actually flooded messages
+    return sb
+
+
+def test_partition_heal_small():
+    """Majority/minority split at 2-of-3, lag-polled heal, recovery
+    measured — and the healed node's replay drains through ClosePipeline
+    as a real >1-ledger backlog (dispatch-ahead prewarm + warm join),
+    which is the LoadGenerator backlog shape doing its job."""
+    sb = run_class("partition_heal")
+    assert sb.recovery_ms is not None and sb.recovery_ms > 0
+    assert sb.pipeline["dispatched"] >= 1, sb.pipeline
+    assert sb.pipeline["joined"] >= 1
+    assert sb.pipeline["quarantined"] == 0
+
+
+def test_byzantine_flood_small():
+    """Invalid-sig envelope+tx flood at volume: every envelope fast-
+    rejected (strict gate at the overlay batch boundary), the verify
+    cache provably un-polluted, the fetch plane un-wedged, and consensus
+    closes ≥10 ledgers under the flood."""
+    spec = small_specs()["byzantine_flood"]
+    flood = spec.faults[0]
+    verify_cache().clear()
+    from stellar_tpu.scenarios.scenario import Scenario
+
+    r = Scenario(spec).run()
+    assert r.ok, r.failures
+    sb = r.scoreboard
+    assert sb.ledgers_closed >= 10
+    assert flood.n_envelopes > 200
+    # every flooded envelope rejected and accounted
+    assert sb.fast_rejects == flood.n_envelopes
+    assert sb.fast_reject_rate_per_sec > 0
+    # quarantine-under-flood: zero latched verdicts (the fault's own
+    # oracle ran inside Scenario.run; re-assert directly here)
+    assert flood.assert_cache_unpolluted() == flood.n_envelopes
+
+
+def test_slow_lossy_small():
+    """Latency + loss/duplicate/reorder/damage on every link: flapped
+    connections are re-established by the link doctor and consensus
+    grinds forward to ≥10 ledgers."""
+    run_class("slow_lossy")
+
+
+def test_crash_restart_small():
+    """3-of-3 quorum: the crash halts the network outright; the restarted
+    validator comes back from its on-disk state and consensus recovers
+    (recovery time measured from the restart)."""
+    sb = run_class("crash_restart")
+    assert sb.recovery_ms is not None and sb.recovery_ms > 0
+
+
+def test_catchup_under_load_small():
+    """A node partitioned past MAX_SLOTS_TO_REMEMBER while the majority
+    closes through checkpoint boundaries under load; it rejoins via
+    history-archive catchup (REAL_TIME clock, like the history suite) and
+    the buffered replay drains through ClosePipeline."""
+    sb = run_class("catchup_load")
+    assert sb.recovery_ms is not None
+    # pipeline backlog stats are reported, not asserted: how many ledgers
+    # buffer during the catchup rounds is real-clock dependent (the
+    # deterministic backlog oracle lives in test_partition_heal_small)
+
+
+@pytest.mark.parametrize(
+    "cls",
+    ["partition_heal", "byzantine_flood", "slow_lossy", "crash_restart"],
+)
+def test_deterministic_replay(cls):
+    """ISSUE r12 satellite 3 (and the acceptance's per-shape replay):
+    same topology + seed + fault program ⇒ identical ledger hashes AND
+    identical scoreboard digest across two runs, for every VIRTUAL-clock
+    class — lossy fault rolls come from the scenario's seeded per-link
+    RNGs (overlay/loopback.py FaultProfile.apply), never the per-process
+    ctor nonce.  Cold verify cache both times (same preconditions).
+    catchup_load runs REAL_TIME (archive subprocesses) and is exempt."""
+    verify_cache().clear()
+    a = run_matrix(only=[cls])[0]
+    verify_cache().clear()
+    b = run_matrix(only=[cls])[0]
+    assert a.ok and b.ok, (a.failures, b.failures)
+    assert a.scoreboard.final_hash == b.scoreboard.final_hash
+    assert a.scoreboard.final_lcls == b.scoreboard.final_lcls
+    assert a.scoreboard.digest() == b.scoreboard.digest()
+    # the digest covers the liveness counters too in virtual mode —
+    # consensus replayed message-for-message, not just state-for-state
+    assert a.scoreboard.nomination_rounds == b.scoreboard.nomination_rounds
+    assert a.scoreboard.ballot_rounds == b.scoreboard.ballot_rounds
+    assert a.scoreboard.fast_rejects == b.scoreboard.fast_rejects
+
+
+def test_core_and_tier_topology_externalizes():
+    """SURVEY §2.11 core-and-tier quorum ring (the chaos plane's big
+    shape): a 3-core mesh + 3-node tier ring externalizes in lockstep —
+    consensus traverses the ring through the core."""
+    from stellar_tpu.simulation import topologies
+
+    sim = topologies.core_and_tier(core_n=3, tier_n=3)
+    sim.start_all_nodes()
+    try:
+        ok = sim.crank_until(lambda: sim.have_all_externalized(3), 240)
+        assert ok, f"core-and-tier stuck at {sim.ledger_nums()}"
+        assert sim.all_ledgers_agree()
+        assert len(sim.topology_keys) == 6
+    finally:
+        sim.stop_all_nodes()
+        sim.clock.shutdown()
+
+
+def test_scenarios_cli_exit_codes():
+    """`python -m stellar_tpu.scenarios` argument contract (relay_watch
+    scenario_liveness_r12 depends on the nonzero-on-unknown path)."""
+    from stellar_tpu.scenarios.__main__ import main
+
+    assert main(["--only", "not_a_fault_class"]) == 2
+
+
+@pytest.mark.slow
+def test_big_matrix_partition_heal():
+    """Core-and-tier ring at the big shape — slow/relay_watch sessions
+    (`--matrix big` in scenario_liveness_r12)."""
+    verify_cache().clear()
+    r = run_matrix(matrix="big", only=["partition_heal"])[0]
+    assert r.ok, r.failures
+    assert r.scoreboard.ledgers_closed >= 10
