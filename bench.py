@@ -1288,6 +1288,13 @@ def bench_ledger_close(n_txs=5000, n_ledgers=3):
             "close_pipeline_depth": (
                 app.close_pipeline.depth if pipe is not None else 0
             ),
+            # multi-chip sharded verify (ISSUE r13): chips on the sig
+            # backend's batch-axis mesh — 0 records unsharded dispatch
+            # (and the cpu backend), so every future bench JSON line
+            # names the dispatch mode it measured
+            "sig_mesh_devices": app.sig_backend.stats().get(
+                "mesh_devices", 0
+            ),
         }
     finally:
         app.graceful_stop()

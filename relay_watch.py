@@ -123,6 +123,16 @@ FINAL_STEPS = [
     ("scenario_liveness_r12",
      [sys.executable, "-u", "-m", "stellar_tpu.scenarios", "--json"],
      600),
+    # r13: real-TPU 1->N sharded-verify scaling curve — one child per
+    # device count through the SHIPPED BatchVerifier(mesh=...) path
+    # (mixed-lane oracle proven per leg), writing the per-chip curve to
+    # MULTICHIP_TPU_r13.json.  The CPU-mesh oracle leg is committed as
+    # MULTICHIP_r13.json relay-independently; this step certifies the
+    # same harness on real chips when a green window opens.
+    ("multichip_scaling_r13",
+     [sys.executable, "-u", "profile_kernel.py", "--mesh-curve", "--tpu",
+      "--leg-timeout", "800"],
+     3400),
 ]
 ALL_NAMES = (
     [s[0] for s in SCRIPT_STEPS]

@@ -365,6 +365,7 @@ class TpuSigBackend(SigBackend):
         self,
         max_batch: int = 4096,
         mesh=None,
+        sig_mesh=0,
         cpu_cutover: int = DEFAULT_TPU_CPU_CUTOVER,
         streams: Optional[int] = None,
         native_hash: Optional[bool] = None,
@@ -373,6 +374,16 @@ class TpuSigBackend(SigBackend):
         from ..ops.ed25519 import BatchVerifier  # lazy: JAX import
 
         self._tracer = tracer if tracer is not None else NULL_TRACER
+        # sig_mesh: the Config.SIG_MESH production wiring — 0/off,
+        # "auto" (all addressable chips), or an explicit device count;
+        # an explicit ``mesh=`` object (tests, the dryrun harness) wins.
+        # Sharded dispatch rides the same BatchVerifier surface, so every
+        # caller class (close flush, pipeline prewarms, overlay batches)
+        # and the wedge-latch/quarantine contracts inherit it unchanged.
+        if mesh is None and sig_mesh:
+            from ..parallel.mesh import mesh_from_spec
+
+            mesh = mesh_from_spec(sig_mesh)
         # native_hash: the C host stage (gate + batch SHA-512 mod L,
         # native/sighash.c) — default auto (on when it builds); stats()
         # reports which stage is live as "native_host_stage"

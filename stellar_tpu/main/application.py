@@ -71,6 +71,7 @@ class Application:
         self.sig_backend = make_backend(
             config.SIGNATURE_BACKEND,
             max_batch=config.SIG_BATCH_MAX,
+            sig_mesh=config.SIG_MESH,
             cpu_cutover=config.TPU_CPU_CUTOVER,
             streams=config.SIG_VERIFY_STREAMS,
             tracer=self.tracer,

@@ -159,6 +159,15 @@ class Config:
         # TPU-native addition: which SigBackend serves batch verifies
         self.SIGNATURE_BACKEND = "cpu"
         self.SIG_BATCH_MAX = 4096
+        # multi-chip sharded verify (parallel/mesh.py): shard every packed
+        # device chunk over a 1-D batch-axis mesh of addressable chips.
+        # 0 = off (single-queue dispatch); "auto" = all addressable
+        # devices (falls back to unsharded on a one-chip host); an int
+        # pins an exact device count (boot fails when the host has
+        # fewer; 1 normalizes to the unsharded single-chip path like a
+        # one-chip "auto").  Only meaningful with SIGNATURE_BACKEND =
+        # "tpu".
+        self.SIG_MESH = 0
         # dispatch streams for multi-chunk verify batches: 2 overlaps one
         # chunk's transport upload with another's execution — worth it
         # only when the accelerator transport pipelines (probe_overlap.py
@@ -299,6 +308,17 @@ class Config:
             raise ValueError("QUORUM_SET threshold must be > 0")
         if self.SIGNATURE_BACKEND not in ("cpu", "tpu"):
             raise ValueError(f"bad SIGNATURE_BACKEND {self.SIGNATURE_BACKEND!r}")
+        sm = self.SIG_MESH
+        if not (
+            sm == 0
+            or sm is False
+            or sm == "auto"
+            or (isinstance(sm, int) and not isinstance(sm, bool) and sm >= 1)
+        ):
+            raise ValueError(
+                f'SIG_MESH must be 0, "auto", or a device count >= 1, '
+                f"got {sm!r}"
+            )
         if not (
             isinstance(self.SIG_VERIFY_STREAMS, int)
             and self.SIG_VERIFY_STREAMS >= 1

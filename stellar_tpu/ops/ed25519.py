@@ -312,13 +312,17 @@ _BLACKLIST = b"".join(ref.small_order_blacklist())
 
 
 class _Staged(NamedTuple):
-    """One staged chunk: the single packed upload buffer plus the host
-    gate verdicts that mask the device results at drain time."""
+    """One staged chunk: the packed upload buffer(s) plus the host
+    gate verdicts that mask the device results at drain time.
 
-    packed: np.ndarray  # (128, bucket) uint8, C-contiguous
+    Unsharded: ``packed`` is the single (128, bucket) buffer.  Under a
+    mesh it is a LIST of per-shard (128, bucket // n_shards) buffers —
+    each uploads straight to its chip (``_upload_sharded``)."""
+
+    packed: object      # (128, bucket) uint8 C-contiguous, or per-shard list
     ok: np.ndarray      # (n,) bool — strict-input gate results
     n: int              # live lanes (bucket - n are zero padding)
-    bufs: tuple         # staging-pool token; released after drain
+    bufs: tuple         # staging-pool token(s); released after drain
 
 
 class _StagingPool:
@@ -348,6 +352,11 @@ class _StagingPool:
 
     def release(self, bufs) -> None:
         if bufs is None:
+            return
+        if not isinstance(bufs[0], np.ndarray):
+            # a mesh chunk's per-shard buffer list: release every pair
+            for pair in bufs:
+                self.release(pair)
             return
         with self._lock:
             self._free.setdefault(bufs[0].shape[1], []).append(bufs)
@@ -432,19 +441,24 @@ class BatchVerifier:
             # either (interpret mode exists but is far slower than XLA)
             backend = "pallas" if jax.default_backend() == "tpu" else "xla"
         self.backend = backend
+        n_shards = len(mesh.devices.flat) if mesh is not None else 1
         if self.backend == "pallas":
             from .ed25519_pallas import NT
 
             # every device batch must be a whole number of pallas tiles —
             # PER SHARD when a mesh splits the batch axis
-            n_shards = len(mesh.devices.flat) if mesh is not None else 1
             self._granule = NT * n_shards
+        else:
+            # every bucket must split evenly over the mesh's batch axis:
+            # staging is one fixed-width buffer per shard, and a chunk
+            # whose length is not divisible by n_shards pads the tail
+            # shard (masked at drain — see _stage_chunk_sharded)
+            self._granule = n_shards
+        if self._granule > 1:
             self.max_batch = max(
                 self._granule,
                 -(-self.max_batch // self._granule) * self._granule,
             )
-        else:
-            self._granule = 1
         self._kernel = self._make_kernel()
         self.n_device_calls = 0
         self.n_items = 0
@@ -471,6 +485,10 @@ class BatchVerifier:
             batch_axis = self.mesh.axis_names[0]
             shard = NamedSharding(self.mesh, PSpec(None, batch_axis))
             vec = NamedSharding(self.mesh, PSpec(batch_axis))
+            # _upload_sharded assembles each chunk's per-shard staging
+            # buffers under exactly this sharding, so the jit below never
+            # inserts a reshard in front of the kernel
+            self._shard_sharding = shard
             if self.backend == "pallas":
                 # jax >= 0.6 exports shard_map at top level with a
                 # check_vma kwarg; 0.4/0.5 have the experimental module
@@ -529,12 +547,12 @@ class BatchVerifier:
         return jax.jit(partial(_verify_packed, batch_inv=True))
 
     def _bucket(self, n: int) -> int:
+        # _granule already folds the mesh width in (n_shards, or NT tiles
+        # per shard for pallas), so every bucket splits evenly over chips
         b = max(self.min_device_batch, self._granule)
         b = -(-b // self._granule) * self._granule  # whole tiles per shard
         while b < n:
             b *= 2
-        if self.mesh is not None:
-            b = max(b, len(self.mesh.devices.flat))
         return min(b, self.max_batch) if n <= self.max_batch else self.max_batch
 
     def verify(self, items: Sequence[Tuple[bytes, bytes, bytes]]) -> List[bool]:
@@ -699,6 +717,8 @@ class BatchVerifier:
         fallback covers toolchain-less hosts."""
         if n == 0:
             return None
+        if self.mesh is not None:
+            return self._stage_chunk_sharded(items, start, n)
         bucket = self._bucket(n)
         bufs = self._pool.acquire(bucket)
         packed, okbuf = bufs
@@ -717,6 +737,52 @@ class BatchVerifier:
             with self._calls_lock:  # stager threads update concurrently
                 self.n_gate_rejects += int(rejects)
         return _Staged(packed, okbuf[:n].astype(bool), n, bufs)
+
+    def _stage_chunk_sharded(self, items, start, n) -> _Staged:
+        """Mesh staging: one pooled ``(128, bucket // n_shards)`` buffer
+        PER SHARD, each filled by its own host-stage pass (the native C
+        stage releases the GIL per call) and uploaded straight to its
+        chip in _dispatch_staged — the global chunk is never repacked on
+        host.  Live lanes occupy global columns [0, n) shard-major; a
+        chunk not divisible by n_shards pads the tail shard and shards
+        past the live range stage nothing (zeroed, inert lanes), so the
+        drain's [:n] mask makes remainders bit-exact with the unsharded
+        path."""
+        n_shards = len(self.mesh.devices.flat)
+        bucket = self._bucket(n)
+        shard_bucket = bucket // n_shards
+        bufs = []
+        ok = np.empty(n, dtype=bool)
+        rejects = 0
+        sp = self._tracer.begin("ed25519.host_hash")
+        for k in range(n_shards):
+            pair = self._pool.acquire(shard_bucket)
+            bufs.append(pair)
+            packed, okbuf = pair
+            lo = k * shard_bucket
+            cnt = min(shard_bucket, max(0, n - lo))
+            if cnt == 0:
+                packed[:] = 0  # dead shard: every lane is inert padding
+                continue
+            if self._sighash is not None:
+                rejects += self._sighash.stage(
+                    items, start + lo, cnt, packed, okbuf, _BLACKLIST,
+                    self._hash_threads,
+                )
+            else:
+                rejects += self._stage_py(items, start + lo, cnt, packed, okbuf)
+            ok[lo : lo + cnt] = okbuf[:cnt].astype(bool)
+        self._tracer.end(
+            sp,
+            items=n,
+            native=self._sighash is not None,
+            rejects=rejects,
+            shards=n_shards,
+        )
+        if rejects:
+            with self._calls_lock:  # stager threads update concurrently
+                self.n_gate_rejects += int(rejects)
+        return _Staged([p for p, _ in bufs], ok, n, tuple(bufs))
 
     def _stage_py(self, items, start, n, packed, okbuf) -> int:
         """Pure-Python host stage (hashlib + the vectorized numpy gate)
@@ -769,13 +835,33 @@ class BatchVerifier:
         if staged is None or not staged.ok.any():
             return None
         dsp = self._tracer.begin("ed25519.device_dispatch")
-        ok = self._kernel(jnp.asarray(staged.packed))
-        self._tracer.end(
-            dsp, bucket=staged.packed.shape[1], backend=self.backend
-        )
+        if self.mesh is not None:
+            arr = self._upload_sharded(staged.packed)
+            bucket = arr.shape[1]
+        else:
+            arr = jnp.asarray(staged.packed)
+            bucket = staged.packed.shape[1]
+        ok = self._kernel(arr)
+        self._tracer.end(dsp, bucket=bucket, backend=self.backend)
         with self._calls_lock:
             self.n_device_calls += 1
         return ok
+
+    def _upload_sharded(self, shards):
+        """One host->device transfer PER SHARD: each chip's C-contiguous
+        staging buffer goes straight to that chip, and the global chunk
+        array is assembled from the single-device pieces under the exact
+        sharding the jitted kernel expects — XLA inserts no reshard, so
+        the only collective in the whole round-trip is the (N,) bool
+        output all-gather the drain joins."""
+        devices = list(self.mesh.devices.flat)
+        singles = [
+            jax.device_put(buf, dev) for buf, dev in zip(shards, devices)
+        ]
+        bucket = sum(buf.shape[1] for buf in shards)
+        return jax.make_array_from_single_device_arrays(
+            (128, bucket), self._shard_sharding, singles
+        )
 
     def stats(self) -> dict:
         # gate_rejects counts the device pipeline's strict-gate verdicts
@@ -789,4 +875,10 @@ class BatchVerifier:
             "host_assist_items": self.n_host_assist_items,
             "native_host_stage": self._sighash is not None,
             "verify_seconds": self.verify_seconds,
+            # 0 = unsharded single-queue dispatch; >0 = chips on the
+            # batch-axis mesh (Config.SIG_MESH; bench close lines carry
+            # this as sig_mesh_devices so every JSON records the mode)
+            "mesh_devices": (
+                len(self.mesh.devices.flat) if self.mesh is not None else 0
+            ),
         }

@@ -164,6 +164,9 @@ def test_bench_close_subprocess_success_path():
     for phase in ("close.sig_flush", "close.apply", "close.commit"):
         assert phase in pb, pb
     assert pb["ledger.close"] > 0
+    # every close line names its dispatch mode (ISSUE r13): the forced-CPU
+    # contract run is unsharded by definition
+    assert out["sig_mesh_devices"] == 0
 
 
 def test_probe_tpu_alive_success_path(monkeypatch):

@@ -467,6 +467,101 @@ class TestShardedVerifier:
         assert bv.verify(items) == expect
         assert bv.n_device_calls == 1
 
+    def _mixed_hostile_items(self, n, seed):
+        """Mixed lanes spanning BOTH rejection planes: valid / corrupt-R /
+        corrupt-s (device-reject) and hostile-s (s >= L) / small-order A /
+        malformed length (host-gate reject) — the lane mix the sharded
+        and unsharded dispatch paths must agree on exactly."""
+        rng = random.Random(seed)
+        items, want = [], []
+        for i in range(n):
+            sk = SecretKey.pseudo_random_for_testing(900 + i)
+            msg = b"mesh diff %d" % i
+            pk, sig = sk.public_raw, bytearray(sk.sign(msg))
+            if i % 6 == 1:
+                sig[rng.randrange(32)] ^= 1 << rng.randrange(8)  # R
+            elif i % 6 == 2:
+                sig[32] ^= 1  # s low byte, stays canonical
+            elif i % 6 == 3:  # hostile s >= L: host gate rejects
+                sig[32:] = (
+                    int.from_bytes(bytes(sig[32:]), "little") + ref.L
+                ).to_bytes(32, "little")
+            elif i % 6 == 4:  # small-order A: host gate rejects
+                bl = ref.small_order_blacklist()
+                pk = bl[i % len(bl)]
+            elif i % 6 == 5:  # malformed signature length
+                sig = sig[:40]
+            sig = bytes(sig)
+            items.append((pk, msg, sig))
+            want.append(
+                len(sig) == 64 and sodium.verify_detached(sig, msg, pk)
+            )
+        return items, want
+
+    def test_sharded_matches_unsharded_mixed_hostile_remainder(self):
+        """Bit-exact verdicts sharded-vs-unsharded-vs-libsodium on mixed
+        valid/invalid/hostile-s lanes, with the live-lane count NOT
+        divisible by the mesh width (43 % 8 != 0): the tail shard pads
+        and two shards are dead — the pad-and-mask remainder path."""
+        from stellar_tpu.parallel.mesh import make_mesh
+
+        devs = jax.devices()
+        mesh = make_mesh(devs[:8])
+        sbv = ed.BatchVerifier(max_batch=64, mesh=mesh, min_device_batch=16)
+        ubv = ed.BatchVerifier(max_batch=64, min_device_batch=16)
+        items, want = self._mixed_hostile_items(43, seed=11)
+        got_s = sbv.verify(items)
+        got_u = ubv.verify(items)
+        assert got_s == want
+        assert got_u == want
+        assert sbv.n_gate_rejects == ubv.n_gate_rejects > 0
+        assert sbv.n_device_calls == 1  # one coalesced sharded dispatch
+
+    def test_sharded_pipeline_multichunk_gate_skip(self):
+        """Multi-chunk sharded pipeline (3 chunks through the stager
+        threads): verdicts identical to the unsharded pipeline AND an
+        all-gate-rejected chunk skips its device dispatch on both paths
+        (hostile floods never reach the chips)."""
+        from stellar_tpu.parallel.mesh import make_mesh
+
+        devs = jax.devices()
+        mesh = make_mesh(devs[:8])
+        sbv = ed.BatchVerifier(max_batch=64, mesh=mesh, min_device_batch=16)
+        ubv = ed.BatchVerifier(max_batch=64, min_device_batch=16)
+        items, want = self._mixed_hostile_items(192, seed=23)
+        # chunk 2 (items 64:128) becomes pure hostile-s: every lane fails
+        # the host strict gate, so that chunk must never dispatch
+        sk = SecretKey.pseudo_random_for_testing(555)
+        msg = b"flood"
+        sig = sk.sign(msg)
+        hostile = sig[:32] + (
+            int.from_bytes(sig[32:], "little") + ref.L
+        ).to_bytes(32, "little")
+        for j in range(64, 128):
+            items[j] = (sk.public_raw, msg, hostile)
+            want[j] = False
+        got_s = sbv.verify(items)
+        got_u = ubv.verify(items)
+        assert got_s == want
+        assert got_u == want
+        assert sbv.n_device_calls == 2  # chunks 1 and 3 only
+        assert ubv.n_device_calls == 2
+
+    @pytest.mark.slow
+    def test_sharded_non_pow2_mesh_width(self):
+        """A 3-device mesh (non-pow2): buckets stay whole multiples of
+        the width and remainders pad-and-mask.  slow: the 3-way GSPMD
+        partition is a new XLA compile shape on CPU hosts."""
+        from stellar_tpu.parallel.mesh import make_mesh
+
+        devs = jax.devices()
+        assert len(devs) >= 3
+        mesh = make_mesh(devs[:3])
+        bv = ed.BatchVerifier(max_batch=48, mesh=mesh, min_device_batch=3)
+        assert bv.max_batch % 3 == 0
+        items, want = self._mixed_hostile_items(40, seed=37)
+        assert bv.verify(items) == want
+
     def test_dryrun_multichip_entrypoint(self):
         """The driver-facing entry must succeed regardless of caller env."""
         import sys
