@@ -54,6 +54,14 @@ def _try_emit(extra: dict) -> bool:
         out["scp_envelope_verifies_per_sec"] = _progress["scp_env"]["rate"]
         out["scp_envelope_backend"] = _progress["scp_env"]["backend"]
         out["scp_envelope_n"] = _progress["scp_env"]["n"]
+        out["scp_envelope_scheme"] = _progress["scp_env"].get(
+            "scheme", "ed25519"
+        )
+    if "scp_env_agg" in _progress:
+        # ISSUE r15: the aggregate-scheme leg on every line — same-slot
+        # ballot storm, one MSM check per bucket, paired same-window
+        # against the per-envelope path on the identical fixture
+        out["scp_envelope_halfagg"] = _progress["scp_env_agg"]
     out.update(extra)
     _record_green(out)
     print(json.dumps(out), flush=True)
@@ -391,11 +399,14 @@ def bench_host_stage(items, reps=3):
     return out
 
 
-def _scp_envelope_items(n):
+def _scp_envelope_items(n, same_slot=None):
     """`n` ballot-protocol envelope verify triples from DISTINCT node keys
     (worst case for the verify cache, which is bypassed) — built once per
     run and shared by the cpu leg, the tpu warmup, and the tpu leg
-    (keygen + XDR pack + sign per item is several seconds of host work)."""
+    (keygen + XDR pack + sign per item is several seconds of host work).
+    ``same_slot`` pins every statement to one slot index — the
+    ballot-storm shape the aggregate-scheme leg pairs against (one slot's
+    ballots are one aggregation bucket)."""
     from stellar_tpu.crypto import SecretKey
     from stellar_tpu.xdr.base import xdr_to_opaque
     from stellar_tpu.xdr.entries import EnvelopeType
@@ -413,7 +424,7 @@ def _scp_envelope_items(n):
         sk = SecretKey.pseudo_random_for_testing(20_000_000 + i)
         st = SCPStatement(
             nodeID=sk.get_public_key(),
-            slotIndex=1_000 + i,
+            slotIndex=same_slot if same_slot is not None else 1_000 + i,
             pledges=SCPStatementPledges(
                 SCPStatementType.SCP_ST_CONFIRM,
                 SCPStatementConfirm(
@@ -461,6 +472,74 @@ def bench_scp_envelopes(n=4096, backend=None, reps=3, items=None):
         "n": n,
         "backend": backend.name,
         "flush": "deferred",
+        "scheme": "ed25519",
+    }
+
+
+def bench_scp_envelope_aggregate(n=1024, reps=3, items=None):
+    """Aggregate-scheme envelope-verify leg (ISSUE r15): a same-slot
+    ballot storm (≥1000 envelopes in ONE slot — the committee shape
+    arXiv:2302.00418 measures) through HalfAggScheme.verify_flush — one
+    half-aggregation MSM check per slot bucket — PAIRED same-window with
+    the per-envelope reference path on the IDENTICAL fixture.  The
+    verdict cache is rebuilt cold per rep (a warm cache would measure
+    memoization, not the scheme); the validator-point cache is warmed
+    once untimed, the steady state a stable quorum set lives in."""
+    from stellar_tpu.crypto.aggregate import native_available
+    from stellar_tpu.crypto.aggregate.scheme import HalfAggScheme
+    from stellar_tpu.crypto.sigbackend import (
+        CALLER_OVERLAY,
+        CachingSigBackend,
+        CpuSigBackend,
+    )
+    from stellar_tpu.crypto.sigcache import VerifySigCache
+
+    if items is None:
+        items = _scp_envelope_items(n, same_slot=7)
+    n = len(items)
+    slots = [7] * n
+
+    def fresh_scheme(point_cache=None):
+        cache = VerifySigCache()
+        sch = HalfAggScheme(
+            CachingSigBackend(CpuSigBackend(), cache), cache
+        )
+        if point_cache is not None:
+            sch.point_cache = point_cache
+        return sch
+
+    warm = fresh_scheme()
+    assert all(warm.verify_flush(items, slots)), (
+        "bench envelope signatures must all verify"
+    )
+    point_cache = warm.point_cache
+    best_agg = float("inf")
+    agg_checks = 0
+    for _ in range(reps):
+        sch = fresh_scheme(point_cache)
+        t0 = time.perf_counter()
+        out = sch.verify_flush(items, slots)
+        best_agg = min(best_agg, time.perf_counter() - t0)
+        assert all(out)
+        assert sch.n_agg_passed >= 1, "aggregate path must engage"
+        agg_checks = sch.n_agg_checks
+    # paired per-envelope leg, same fixture, same window
+    be = CpuSigBackend()
+    best_ref = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = be.verify_batch(items, caller=CALLER_OVERLAY)
+        best_ref = min(best_ref, time.perf_counter() - t0)
+        assert all(out)
+    return {
+        "scheme": "ed25519-halfagg",
+        "rate": round(n / best_agg, 1),
+        "rate_per_envelope_paired": round(n / best_ref, 1),
+        "speedup_vs_per_envelope": round(best_ref / best_agg, 2),
+        "n": n,
+        "slots": 1,
+        "agg_checks": agg_checks,
+        "native_msm": native_available(),
     }
 
 
@@ -662,6 +741,18 @@ def _main():
             _progress["scp_env"] = bench_scp_envelopes(items=scp_items)
         except Exception as e:
             print(f"# bench: scp-envelope cpu leg failed: {e}",
+                  file=sys.stderr)
+    # aggregate-scheme envelope leg (ISSUE r15): relay-independent, its
+    # own same-slot ballot-storm fixture (≥1000 envelopes, one slot),
+    # paired against the per-envelope path in the same window
+    if os.environ.get("BENCH_SCP_AGG", "1") != "0":
+        _progress.update(stage="scp-envelopes-halfagg")
+        try:
+            _progress["scp_env_agg"] = bench_scp_envelope_aggregate(
+                n=int(os.environ.get("BENCH_SCP_AGG_N", "1024"))
+            )
+        except Exception as e:
+            print(f"# bench: scp-envelope aggregate leg failed: {e}",
                   file=sys.stderr)
     # Byzantine-flood fast-reject leg (ISSUE r12): relay-independent,
     # shares the envelope fixture; also pins the no-latch-invalid verify

@@ -133,6 +133,18 @@ FINAL_STEPS = [
      [sys.executable, "-u", "profile_kernel.py", "--mesh-curve", "--tpu",
       "--leg-timeout", "800"],
      3400),
+    # r15: aggregate-signature envelope leg — the same-slot ballot-storm
+    # pairing (half-aggregation MSM check vs per-envelope libsodium on
+    # the identical >=1024-envelope fixture) re-certified in a green
+    # window; relay-independent, but green-window-paired so the committed
+    # speedup rides a quiet host.  Exits nonzero when the aggregate leg
+    # stops beating the per-envelope leg.
+    ("aggregate_envelope_r15",
+     [sys.executable, "-u", "-c",
+      "import json, bench; r = bench.bench_scp_envelope_aggregate(); "
+      "print(json.dumps(r)); "
+      "assert r['speedup_vs_per_envelope'] > 1.0, r"],
+     900),
 ]
 ALL_NAMES = (
     [s[0] for s in SCRIPT_STEPS]

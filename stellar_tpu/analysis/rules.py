@@ -183,12 +183,24 @@ class CacheLatchRule(Rule):
 
     id = "cache-latch"
     doc = (
-        "VerifySigCache write outside the CachingSigBackend/SigFlushFuture"
-        " completion/latch paths — bypasses the quarantine contract"
+        "VerifySigCache write outside the CachingSigBackend/SigFlushFuture/"
+        "HalfAggScheme completion/latch paths — bypasses the quarantine"
+        " contract"
     )
 
     WRITES = {"put", "put_many", "drop_many"}
-    LATCH_CLASSES = {"VerifySigCache", "CachingSigBackend", "SigFlushFuture"}
+    # HalfAggScheme (crypto/aggregate/scheme.py, r15): an aggregate-
+    # accepted slot bucket latches its verdicts synchronously on the
+    # caller's thread, and ONLY True verdicts can reach that latch
+    # (completeness of the half-aggregation check is exact) — the same
+    # valid-only contract as the synchronous CachingSigBackend path, with
+    # no async future to quarantine.  Fixtures: cache_latch_{pos,neg}.py.
+    LATCH_CLASSES = {
+        "VerifySigCache",
+        "CachingSigBackend",
+        "SigFlushFuture",
+        "HalfAggScheme",
+    }
 
     def applies(self, ctx: FileContext) -> bool:
         # only modules that touch the verify-cache plane at all; EntryCache

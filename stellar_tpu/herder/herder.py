@@ -144,6 +144,22 @@ class Herder(SCPDriver):
         self.n_ballot_rounds = 0
         self._ballot_round_high: Dict[int, int] = {}
 
+        # per-slot aggregation buckets (TRUSTED post-verify accounting):
+        # slot -> {statement-type int -> count} for envelopes that passed
+        # the eager signature gate.  This is the herder-side ledger of
+        # what the aggregate scheme's slot buckets saw — surfaced via
+        # dump_info / the chaos scoreboard, trimmed with slot_closed.
+        # Reads come from cxdrpack.getfield over the envelope's raw XDR
+        # (HerderImpl.cpp:347-364's type switch), never a re-decode.
+        # Hard-capped: while NOT tracking there is no slot bracket, so a
+        # flood of validly-self-signed envelopes with arbitrary far-future
+        # slot indexes would otherwise grow this dict unboundedly (the
+        # close-time trim never reaches slots above the chain tip); when
+        # full, the farthest-future slot loses its telemetry — honest
+        # traffic clusters at the bracket's low end.
+        self.scp_slot_buckets: Dict[int, Dict[int, int]] = {}
+        self.MAX_SLOT_BUCKETS = 1024
+
         m = app.metrics
         self.m_envelope_sign = m.new_meter(("scp", "envelope", "sign"), "envelope")
         self.m_envelope_validsig = m.new_meter(("scp", "envelope", "validsig"), "envelope")
@@ -155,6 +171,17 @@ class Herder(SCPDriver):
         self.m_value_externalize = m.new_meter(("scp", "value", "externalize"), "value")
         self.m_quorum_heard = m.new_meter(("scp", "quorum", "heard"), "quorum")
         self.m_lost_sync = m.new_meter(("scp", "sync", "lost"), "sync")
+        # post-verify per-statement-type meters (the reference's type
+        # switch right after the eager verify, HerderImpl.cpp:347-364)
+        from ..xdr.scp import SCPStatementType
+
+        self.m_envelope_type = {
+            int(t): m.new_meter(
+                ("scp", "envelope", t.name.replace("SCP_ST_", "").lower()),
+                "envelope",
+            )
+            for t in SCPStatementType
+        }
 
     # ------------------------------------------------------------------
     # state machine
@@ -231,10 +258,27 @@ class Herder(SCPDriver):
         self.m_envelope_sign.mark()
         envelope.signature = self.secret_key.sign(self._envelope_payload(envelope))
 
+    def _scheme(self):
+        """The node's SCP signature scheme (Config.SCP_SIG_SCHEME); a
+        bare test harness without an Application-built scheme rides the
+        reference per-envelope path."""
+        scheme = getattr(self.app, "scp_scheme", None)
+        if scheme is None:
+            from ..crypto.aggregate import make_scheme
+            from ..crypto.keys import verify_cache
+
+            scheme = make_scheme(
+                "ed25519", self.app.sig_backend, verify_cache()
+            )
+            self.app.scp_scheme = scheme
+        return scheme
+
     def verify_envelope(self, envelope: SCPEnvelope) -> bool:
-        """The second runtime ed25519 hot spot (SURVEY §2.8 site 2); hits
-        the shared verify cache pre-warmed by overlay batch flushes."""
-        ok = PubKeyUtils.verify_sig(
+        """The second runtime ed25519 hot spot (SURVEY §2.8 site 2);
+        routed through the scheme seam — under either scheme this is a
+        warm-cache hit for envelopes the overlay batch flush (or an
+        aggregate-accepted slot bucket) already verified."""
+        ok = self._scheme().verify_envelope_cached(
             envelope.statement.nodeID,
             envelope.signature,
             self._envelope_payload(envelope),
@@ -567,6 +611,8 @@ class Herder(SCPDriver):
         self.trigger_timer.cancel()
         last_index = self.last_consensus_ledger_index()
         self.pending_envelopes.slot_closed(last_index)
+        for s in [s for s in self.scp_slot_buckets if s <= last_index]:
+            del self.scp_slot_buckets[s]
         om = self.app.overlay_manager
         if om is not None:
             om.ledger_closed(last_index)
@@ -671,12 +717,13 @@ class Herder(SCPDriver):
         # never reach the fetch plane — a byzantine flood of invalid-sig
         # envelopes referencing made-up qset/txset hashes would otherwise
         # wedge in `fetching` forever AND spray item-fetch requests for
-        # hashes nobody has.  The overlay's per-crank batch flush already
-        # verified (and dropped) its batch, so this check is a warm-cache
-        # hit for every honest envelope; only the reject marks here — the
-        # accept mark stays at SCP's own pre-process verify so
+        # hashes nobody has.  Routed through the scheme seam: the
+        # overlay's per-crank batch flush (per-envelope or aggregate)
+        # already verified-and-dropped its batch, so this check is a
+        # warm-cache hit for every honest envelope; only the reject marks
+        # here — the accept mark stays at SCP's own pre-process verify so
         # validsig/invalidsig stay one-mark-per-envelope.
-        ok = PubKeyUtils.verify_sig(
+        ok = self._scheme().verify_envelope_cached(
             envelope.statement.nodeID,
             envelope.signature,
             self._envelope_payload(envelope),
@@ -684,7 +731,31 @@ class Herder(SCPDriver):
         if not ok:
             self.m_envelope_invalidsig.mark()
             return
-        self.pending_envelopes.recv_scp_envelope(envelope)
+        # TRUSTED post-verify plane from here on: the envelope's raw XDR
+        # (packed from our own decode, signature just checked) serves the
+        # hot slot-index / statement-type reads via the C field accessors
+        # — no re-decode — and doubles as the pending-envelope identity
+        # key, so the queue never re-packs it (reference anchor
+        # HerderImpl.cpp:347-364's post-verify type switch; the UNTRUSTED
+        # pre-verify ingest above keeps full decode, per the PR 3
+        # rationale in pendingenvelopes._required_items).
+        raw = envelope.to_xdr()
+        slot = xdr_getfield(SCPEnvelope, raw, "statement.slotIndex")
+        stype = xdr_getfield(SCPEnvelope, raw, ("statement", "pledges"))
+        meter = self.m_envelope_type.get(stype)
+        if meter is not None:
+            meter.mark()
+        bucket = self.scp_slot_buckets.get(slot)
+        if bucket is None and len(self.scp_slot_buckets) >= self.MAX_SLOT_BUCKETS:
+            evict = max(self.scp_slot_buckets)
+            if slot < evict:
+                del self.scp_slot_buckets[evict]
+                bucket = self.scp_slot_buckets.setdefault(slot, {})
+        elif bucket is None:
+            bucket = self.scp_slot_buckets.setdefault(slot, {})
+        if bucket is not None:
+            bucket[stype] = bucket.get(stype, 0) + 1
+        self.pending_envelopes.recv_scp_envelope(envelope, raw=raw)
 
     def note_envelope_rejected(self, envelope: SCPEnvelope) -> None:
         """The overlay's batch flush verified this envelope's signature
@@ -898,4 +969,8 @@ class Herder(SCPDriver):
             "tracking": self.tracking.index if self.tracking else None,
             "queue": self.pending_envelopes.dump_info(),
             "scp": self.scp.dump_info(),
+            "sig_scheme": self._scheme().stats(),
+            "slot_buckets": {
+                s: dict(v) for s, v in self.scp_slot_buckets.items()
+            },
         }

@@ -168,23 +168,35 @@ class PendingEnvelopes:
                 om.tx_set_fetcher.fetch(h, envelope)
 
     # -- envelope flow ------------------------------------------------------
-    def recv_scp_envelope(self, envelope: SCPEnvelope) -> None:
+    def recv_scp_envelope(
+        self, envelope: SCPEnvelope, raw: Optional[bytes] = None
+    ) -> None:
+        """``raw`` is the envelope's packed XDR when the caller already
+        has it (the herder's post-verify plane packs it once for its
+        getfield accounting) — the identity key here, saving a re-pack
+        per envelope per queue touch."""
         slot = envelope.statement.slotIndex
-        key = envelope.to_xdr()
+        key = raw if raw is not None else envelope.to_xdr()
         if key in self.processed.get(slot, {}):
             return
         if key in self.fetching.get(slot, {}):
             return
         if self.is_fully_fetched(envelope):
-            self._envelope_ready(envelope)
+            self._envelope_ready(envelope, key=key)
         else:
             self.fetching.setdefault(slot, {})[key] = envelope
             self._size_counter.inc()
             self._start_fetch(envelope)
 
-    def _envelope_ready(self, envelope: SCPEnvelope, process: bool = True) -> None:
+    def _envelope_ready(
+        self,
+        envelope: SCPEnvelope,
+        process: bool = True,
+        key: Optional[bytes] = None,
+    ) -> None:
         slot = envelope.statement.slotIndex
-        key = envelope.to_xdr()
+        if key is None:
+            key = envelope.to_xdr()
         self.processed.setdefault(slot, {})[key] = envelope
         # flood the now-complete envelope onward (PendingEnvelopes.cpp
         # envelopeReady) — the Floodgate dedups, so relaying here is what
@@ -207,13 +219,13 @@ class PendingEnvelopes:
                 if self.is_fully_fetched(env):
                     del envs[key]
                     self._size_counter.dec()
-                    ready.append(env)
+                    ready.append((env, key))
         # queue the WHOLE ready batch before processing: when the batch
         # spans several externalizable slots (a lagging node's replay),
         # the herder's sweep sees them all pending and the ledger closes
         # drain as one pipelined backlog rather than one close per item
-        for env in ready:
-            self._envelope_ready(env, process=False)
+        for env, key in ready:
+            self._envelope_ready(env, process=False, key=key)
         if ready:
             self.herder.process_scp_queue()
 

@@ -201,8 +201,15 @@ class ClosePipeline:
             n_sets += 1
             n_items += len(triples)
         # pending SCP envelopes coalesced for this crank's batch flush:
-        # verify them while apply runs so the flush is a cache hit
+        # verify them while apply runs so the flush is a cache hit.  Only
+        # for schemes that verify per-envelope anyway — under
+        # SCP_SIG_SCHEME="ed25519-halfagg" a per-envelope prewarm would
+        # pre-latch every verdict and starve the aggregate path of its
+        # slot buckets (the aggregate check is the cheap path there)
+        scheme = getattr(self.app, "scp_scheme", None)
         om = getattr(self.app, "overlay_manager", None)
+        if scheme is not None and not scheme.wants_envelope_prewarm:
+            om = None
         if om is not None:
             scp_triples = om.pending_scp_triples()
             if scp_triples:

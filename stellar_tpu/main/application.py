@@ -76,6 +76,20 @@ class Application:
             streams=config.SIG_VERIFY_STREAMS,
             tracer=self.tracer,
         )
+        # the SCP_SIG_SCHEME knob (crypto/aggregate/): how the overlay's
+        # per-crank envelope flush and the herder's eager checks dispatch
+        # — per-envelope through sig_backend (the reference path) or
+        # slot-bucketed half-aggregation with sig_backend as the
+        # non-aggregatable fallback
+        from ..crypto.aggregate import make_scheme
+        from ..crypto.keys import verify_cache
+
+        self.scp_scheme = make_scheme(
+            config.SCP_SIG_SCHEME,
+            self.sig_backend,
+            verify_cache(),
+            tracer=self.tracer,
+        )
         # ledger-invariant plane (stellar_tpu/invariant/): close-time
         # safety checks driven by LedgerManager, reported via /invariants
         from ..invariant import InvariantManager

@@ -168,6 +168,17 @@ class Config:
         # one-chip "auto").  Only meaningful with SIGNATURE_BACKEND =
         # "tpu".
         self.SIG_MESH = 0
+        # TPU-native addition: which signature scheme serves SCP envelope
+        # verification for the quorum set this node faces
+        # (crypto/aggregate/).  "ed25519" = the reference per-envelope
+        # path through the SigBackend batch plane; "ed25519-halfagg"
+        # verifies each slot's ballot bucket as ONE half-aggregation MSM
+        # check (falling back to the per-envelope plane for thin buckets
+        # and poisoned aggregates), so a node facing thousands of
+        # validators pays O(1) aggregate checks per slot instead of N
+        # batch lanes.  Verdicts are bit-identical either way
+        # (tests/test_halfagg.py differential suite).
+        self.SCP_SIG_SCHEME = "ed25519"
         # dispatch streams for multi-chunk verify batches: 2 overlaps one
         # chunk's transport upload with another's execution — worth it
         # only when the accelerator transport pipelines (probe_overlap.py
@@ -308,6 +319,10 @@ class Config:
             raise ValueError("QUORUM_SET threshold must be > 0")
         if self.SIGNATURE_BACKEND not in ("cpu", "tpu"):
             raise ValueError(f"bad SIGNATURE_BACKEND {self.SIGNATURE_BACKEND!r}")
+        # a typo'd scheme name must fail the boot, not the first flush
+        from ..crypto.aggregate import validate_scheme
+
+        validate_scheme(self.SCP_SIG_SCHEME)
         sm = self.SIG_MESH
         if not (
             sm == 0

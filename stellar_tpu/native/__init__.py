@@ -261,6 +261,32 @@ def load_cxdrpack():
 _SIGHASH_SRC = os.path.join(_HERE, "sighash.c")
 _SIGHASH_SO = os.path.join(_HERE, "_sighash.so")
 
+# -- halfagg: the ed25519 half-aggregation curve core (CPython extension) ----
+
+_HALFAGG_SRC = os.path.join(_HERE, "halfagg.c")
+_HALFAGG_SO = os.path.join(_HERE, "_halfagg.so")
+
+_halfagg_lock = threading.Lock()
+_halfagg_mod = None
+_halfagg_tried = False
+
+
+def load_halfagg():
+    """The compiled half-aggregation curve core (strict batch point
+    ``decompress`` + Pippenger ``msm``/``msm_ext``), or None (the
+    aggregate plane falls back to the pure-Python ref25519 path —
+    correct, but slow enough that the scheme only wins with this
+    module built)."""
+    global _halfagg_mod, _halfagg_tried
+    with _halfagg_lock:
+        if _halfagg_mod is not None or _halfagg_tried:
+            return _halfagg_mod
+        _halfagg_tried = True
+        _halfagg_mod = _load_extension(
+            "_halfagg", _HALFAGG_SRC, _san_so(_HALFAGG_SO)
+        )
+        return _halfagg_mod
+
 _sighash_lock = threading.Lock()
 _sighash_mod = None
 _sighash_tried = False

@@ -1123,6 +1123,21 @@ cxdr_getfield(PyObject *self, PyObject *args)
         case K_ENUM: case K_OPAQUE: case K_VAROPAQUE: case K_STRING:
             out = unpack_node(&w, idx, &rd);
             break;
+        case K_UNION: {
+            /* terminal union: the path addresses the DISCRIMINANT (as a
+             * plain int) without descending into an arm — the hot
+             * statement-type read on the trusted post-verify envelope
+             * plane (walk_path left rd at the union's first byte) */
+            if (rd_need(&w, &rd, 4, "discriminant") < 0)
+                break;
+            long dv = (long)(int)rd_be32(&rd);
+            if (nd->sw_kind == 2)
+                out = PyLong_FromUnsignedLong(
+                    (unsigned long)(unsigned int)dv);
+            else
+                out = PyLong_FromLong(dv);
+            break;
+        }
         default:
             xdr_err(&w, "field path does not end at a scalar");
         }

@@ -203,6 +203,33 @@ def strict_input_ok_batch(pk: "np.ndarray", sig: "np.ndarray") -> "np.ndarray":
     return ok
 
 
+def agg_input_ok_batch(pk: "np.ndarray", sig: "np.ndarray") -> "np.ndarray":
+    """The aggregate plane's item gate: libsodium's strict gate PLUS a
+    canonical-R requirement.  libsodium never decodes R — it compares the
+    signature's R bytes against the canonical encoding of s·B - h·A, so a
+    non-canonical R can never verify; the aggregate path DOES decode R and
+    must therefore reject the non-canonical aliases up front or its accept
+    set would exceed libsodium's (verdict-parity contract,
+    tests/test_halfagg.py hostile lanes)."""
+    import numpy as np
+
+    ok = strict_input_ok_batch(pk, sig)
+    r_m = sig[:, :32].copy()
+    r_m[:, 31] &= 0x7F
+    r_words = r_m.view("<u8").reshape(-1, 4)
+    ok &= _le_lt(r_words, P)  # canonical R (sign bit ignored)
+    return ok
+
+
+def agg_input_ok(pk: bytes, sig: bytes) -> bool:
+    """Scalar twin of ``agg_input_ok_batch`` (oracle + tiny batches)."""
+    return (
+        strict_input_ok(pk, sig)
+        and len(sig) == 64
+        and fe_is_canonical(sig[:32])
+    )
+
+
 def strict_input_ok(pk: bytes, sig: bytes) -> bool:
     """The pre-curve-math reject gate of libsodium crypto_sign_verify_detached
     (non-COMPAT build): non-canonical s, small-order R, non-canonical or

@@ -212,13 +212,23 @@ class OverlayManager:
             return
         herder = self.app.herder
         triples = [herder.envelope_verify_triple(env) for env in batch]
-        # own caller class: a wedge latch flipped by this crank-driven
-        # flush (or by a pipelined prewarm) stays scoped to its plane
-        from ..crypto.sigbackend import CALLER_OVERLAY
+        # hand the batch SLOT-GROUPED to the node's SCP signature scheme
+        # (Config.SCP_SIG_SCHEME): the per-envelope scheme is exactly the
+        # old sig_backend.verify_batch(caller=CALLER_OVERLAY) call; the
+        # half-aggregation scheme buckets these triples per slot and
+        # verifies each bucket as one MSM check, with the same backend
+        # (same caller class, so the wedge latch stays per-plane) as the
+        # fallback for thin buckets and poisoned aggregates
+        slots = [env.statement.slotIndex for env in batch]
+        scheme = getattr(self.app, "scp_scheme", None)
+        if scheme is not None:
+            verdicts = scheme.verify_flush(triples, slots)
+        else:  # bare harness apps without an Application-built scheme
+            from ..crypto.sigbackend import CALLER_OVERLAY
 
-        verdicts = self.app.sig_backend.verify_batch(
-            triples, caller=CALLER_OVERLAY
-        )
+            verdicts = self.app.sig_backend.verify_batch(
+                triples, caller=CALLER_OVERLAY
+            )
         self.m_scp_batch_flush.mark()
         self.m_scp_batch_size.inc(len(batch))
         # strict-gate fast-reject at the flood boundary: the batch verify

@@ -181,7 +181,20 @@ class ByzantineFlood(Fault):
 
     The fault records every injected envelope's verify-cache key:
     ``assert_cache_unpolluted`` pins the no-latch-invalid contract
-    (ISSUE r12 satellite 2) after the run."""
+    (ISSUE r12 satellite 2) after the run — extended (ISSUE r15) to
+    aggregate verdicts: storm keys may latch only True, never False.
+
+    ``storm_per_tick`` adds the VALID-signature ballot storm (the
+    expensive flood class: every envelope passes the strict gate and
+    pays full curve math).  Storm envelopes are CONFIRM ballots from
+    distinct ephemeral keys, pre-built and pre-signed at arm time (so
+    injection never competes with the node for signing CPU), pinned to
+    ``storm_slot`` — below the herder's slot bracket, so they exercise
+    exactly the signature plane (the overlay flush verifies them; the
+    herder drops them before the fetch/SCP planes) and land in ONE
+    aggregation bucket per crank under SCP_SIG_SCHEME="ed25519-halfagg".
+    They reference the target's real quorum-set hash: even a bracket
+    straggler can never wedge the fetch plane."""
 
     at: float
     until: float
@@ -189,15 +202,80 @@ class ByzantineFlood(Fault):
     envelopes_per_tick: int = 25
     txs_per_tick: int = 5
     tick: float = 0.5
+    storm_per_tick: int = 0
+    storm_slot: int = 2
+    # the storm signs from a FIXED byzantine committee (keys reused
+    # across ticks, messages always distinct): realistic — an adversary
+    # controls a validator set, not infinite fresh identities — and it
+    # exercises the aggregate plane's validator-point cache the way a
+    # real quorum does (A_i decode amortizes; only fresh R_i pay)
+    storm_validators: int = 200
 
     def __post_init__(self):
         self.n_envelopes = 0
         self.n_txs = 0
+        self.n_storm = 0
         self._cache_keys: List[bytes] = []
+        self._storm_keys: List[bytes] = []
+        self._storm_pool: List = []
 
     def arm(self, scn) -> None:
         self._rng = random.Random(scn.spec.seed ^ 0xF100D)
+        if self.storm_per_tick:
+            self._build_storm_pool(scn)
         self._at(scn, self.at, lambda: self._tick_fn(scn), slot='tick')
+
+    def _build_storm_pool(self, scn) -> None:
+        """Pre-sign the whole storm: one envelope per (tick, index) the
+        window can consume, deterministic per scenario seed."""
+        from ..crypto.keys import SecretKey, verify_cache
+        from ..xdr.base import xdr_to_opaque
+        from ..xdr.entries import EnvelopeType
+        from ..xdr.scp import (
+            SCPBallot,
+            SCPEnvelope,
+            SCPStatement,
+            SCPStatementConfirm,
+            SCPStatementPledges,
+            SCPStatementType,
+        )
+
+        app = scn.sim.nodes[scn.sim._raw_key(scn.node_keys[self.target])]
+        qset_hash = app.herder.scp.local_qset_hash
+        n_ticks = int((self.until - self.at) / self.tick) + 2
+        n = self.storm_per_tick * n_ticks
+        base = 50_000_000 + (scn.spec.seed % 1000) * 100_000
+        committee = [
+            SecretKey.pseudo_random_for_testing(base + i)
+            for i in range(self.storm_validators)
+        ]
+        for i in range(n):
+            sk = committee[i % self.storm_validators]
+            st = SCPStatement(
+                nodeID=sk.get_public_key(),
+                slotIndex=self.storm_slot,
+                pledges=SCPStatementPledges(
+                    SCPStatementType.SCP_ST_CONFIRM,
+                    SCPStatementConfirm(
+                        qset_hash,
+                        1,
+                        # NOT StellarValue-decodable: can never read as a
+                        # txset dependency even off the bracket path
+                        SCPBallot(1, b"storm %08d" % i),
+                        1,
+                    ),
+                ),
+            )
+            payload = xdr_to_opaque(
+                app.network_id, EnvelopeType.ENVELOPE_TYPE_SCP, st
+            )
+            env = SCPEnvelope(statement=st, signature=sk.sign(payload))
+            self._storm_pool.append(env)
+            self._storm_keys.append(
+                verify_cache().key_for(
+                    sk.public_raw, env.signature, payload
+                )
+            )
 
     # -- injection ----------------------------------------------------------
     def _tick_fn(self, scn) -> None:
@@ -211,6 +289,13 @@ class ByzantineFlood(Fault):
                 self._inject_envelope(app)
             for _ in range(self.txs_per_tick):
                 self._inject_tx(app)
+            for _ in range(self.storm_per_tick):
+                if not self._storm_pool:
+                    break
+                app.overlay_manager.enqueue_scp_envelope(
+                    self._storm_pool.pop()
+                )
+                self.n_storm += 1
         self._at(scn, self.tick, lambda: self._tick_fn(scn), slot='tick')
 
     def _forged_envelope(self, app):
@@ -293,7 +378,10 @@ class ByzantineFlood(Fault):
     def assert_cache_unpolluted(self) -> int:
         """The shared verify cache must hold NO verdict for any flooded
         invalid-sig envelope (the no-latch-invalid / quarantine-under-
-        flood contract).  Returns how many keys were checked."""
+        flood contract) — and, for the valid-sig storm, no FALSE verdict
+        either (an aggregate-accepted bucket latches True only; a False
+        anywhere means some path broke the valid-only latch contract).
+        Returns how many keys were checked."""
         from ..crypto.keys import verify_cache
 
         latched = [
@@ -305,7 +393,17 @@ class ByzantineFlood(Fault):
                 "%d/%d flooded invalid-sig envelopes latched a verdict in"
                 " the shared verify cache" % (len(latched), len(self._cache_keys))
             )
-        return len(self._cache_keys)
+        storm_false = [
+            v for v in verify_cache().peek_many(self._storm_keys)
+            if v is False
+        ]
+        if storm_false:
+            raise AssertionError(
+                "%d/%d storm envelopes latched a FALSE verdict — the"
+                " valid-only latch contract broke on the aggregate path"
+                % (len(storm_false), len(self._storm_keys))
+            )
+        return len(self._cache_keys) + len(self._storm_keys)
 
 
 @dataclass

@@ -11,6 +11,12 @@ Fault classes (ROADMAP #5 / ISSUE r12 acceptance):
                           replays the missed slots through ClosePipeline
 - ``byzantine_flood``   — invalid-signature envelope + tx flood at volume
                           (strict-gate fast-reject, CALLER_OVERLAY plane)
+- ``byzantine_flood_halfagg`` — the same invalid flood plus a VALID-
+                          signature ballot storm under
+                          SCP_SIG_SCHEME="ed25519-halfagg" (ISSUE r15):
+                          storm buckets verify as aggregate MSM checks;
+                          the paired per-signature A/B compares scheme
+                          verify wall at the same rate
 - ``slow_lossy``        — latency + loss/duplicate/reorder/damage on every
                           link; flapped connections re-established by the
                           link doctor
@@ -40,6 +46,7 @@ from .scenario import Scenario, ScenarioResult, ScenarioSpec
 FAULT_CLASSES = (
     "partition_heal",
     "byzantine_flood",
+    "byzantine_flood_halfagg",
     "slow_lossy",
     "crash_restart",
     "catchup_load",
@@ -80,6 +87,33 @@ def small_specs(seed: int = 1) -> Dict[str, ScenarioSpec]:
                 ByzantineFlood(
                     at=0.5, until=7.0, target=0,
                     envelopes_per_tick=25, txs_per_tick=5, tick=0.4,
+                )
+            ],
+            target_ledgers=14,
+            min_ledgers_per_sec=0.2,
+            timeout=180.0,
+        ),
+        # the aggregate-scheme flood leg (ISSUE r15): the SAME invalid
+        # flood plus a VALID-signature ballot storm — the expensive flood
+        # class, where every envelope passes the strict gate and pays
+        # full curve math.  Under "ed25519-halfagg" each crank's storm
+        # bucket verifies as ONE aggregate MSM check; the paired A/B in
+        # tests/test_scenarios.py runs this identical spec under
+        # "ed25519" and asserts the per-signature path pays >= ~2x the
+        # scheme verify wall at the same rate (the wall that wedges a
+        # flooded crank), while this leg holds the same liveness floor
+        # with the cache provably clean of aggregate-path pollution.
+        "byzantine_flood_halfagg": ScenarioSpec(
+            name="byzantine_flood_halfagg_small",
+            fault_class="byzantine_flood_halfagg",
+            n_nodes=3,
+            seed=seed,
+            scp_sig_scheme="ed25519-halfagg",
+            faults=[
+                ByzantineFlood(
+                    at=0.5, until=7.0, target=0,
+                    envelopes_per_tick=10, txs_per_tick=2, tick=0.4,
+                    storm_per_tick=240,
                 )
             ],
             target_ledgers=14,
@@ -167,6 +201,14 @@ def big_specs(seed: int = 1) -> Dict[str, ScenarioSpec]:
                 ByzantineFlood(
                     at=0.5, until=20.0, target=0,
                     envelopes_per_tick=100, txs_per_tick=20, tick=0.4,
+                )
+            ]
+        elif cls == "byzantine_flood_halfagg":
+            big.faults = [
+                ByzantineFlood(
+                    at=0.5, until=20.0, target=0,
+                    envelopes_per_tick=40, txs_per_tick=8, tick=0.4,
+                    storm_per_tick=400,
                 )
             ]
         elif cls == "partition_heal":

@@ -53,6 +53,10 @@ class ScenarioSpec:
     tier_n: int = 0
     clock_mode: str = "virtual"  # "virtual" | "real"
     seed: int = 1
+    # SCP envelope signature scheme for every node (Config.SCP_SIG_SCHEME):
+    # "ed25519" or "ed25519-halfagg" — the flood matrix runs the same
+    # storm under both and compares scheme verify wall
+    scp_sig_scheme: str = "ed25519"
     # load (streams through node `load_target` for the whole run)
     load_accounts: int = 6
     load_txs: int = 400
@@ -134,6 +138,7 @@ class Scenario:
         cfg = get_test_config(_INSTANCE_BASE + i)
         cfg.MANUAL_CLOSE = False
         cfg.ARTIFICIALLY_ACCELERATE_TIME_FOR_TESTING = True
+        cfg.SCP_SIG_SCHEME = self.spec.scp_sig_scheme
         if self.spec.disk_db or self.spec.archives:
             cfg.DATABASE = f"sqlite3://{self.workdir}/node{i}.db"
         if self.spec.archives:

@@ -29,6 +29,16 @@ _PIPE_KEYS = (
     "overlap_hidden_ms", "backlog_drains",
 )
 
+# SCP signature-scheme plane (crypto/aggregate/): flushed envelope count
+# and verify wall are reported for BOTH schemes (the flood A/B compares
+# verify_wall_ms across schemes at the same storm); the agg_* counters
+# stay zero under the per-envelope scheme.  Wall is thread/host timing —
+# reported, never digested.
+_AGG_KEYS = (
+    "flush_envelopes", "verify_wall_ms", "agg_checks", "agg_envelopes",
+    "fallback_envelopes", "gate_rejects",
+)
+
 
 def _node_counters(app) -> Dict[str, int]:
     h = app.herder
@@ -36,9 +46,14 @@ def _node_counters(app) -> Dict[str, int]:
     inv = getattr(app, "invariants", None)
     pipe = getattr(app, "close_pipeline", None)
     pipe_stats = pipe.stats() if pipe is not None else {}
+    scheme = getattr(app, "scp_scheme", None)
+    scheme_stats = scheme.stats() if scheme is not None else {}
     out = {
         "pipe." + k: pipe_stats.get(k, 0) for k in _PIPE_KEYS
     }
+    out.update(
+        {"agg." + k: scheme_stats.get(k, 0) for k in _AGG_KEYS}
+    )
     out.update({
         "externalized": h.m_value_externalize.count if h else 0,
         "nomination_rounds": h.n_nomination_rounds if h else 0,
@@ -101,6 +116,9 @@ class LivenessScoreboard:
     final_hash: str = ""  # ledger hash at the lowest common sequence
     # close pipeline (reported, excluded from digest: thread timing)
     pipeline: Dict[str, float] = field(default_factory=dict)
+    # SCP signature-scheme plane (reported, excluded from digest: wall
+    # timing; the flood A/B reads verify_wall_ms across schemes)
+    aggregate: Dict[str, float] = field(default_factory=dict)
     notes: List[str] = field(default_factory=list)
 
     @classmethod
@@ -157,6 +175,10 @@ class LivenessScoreboard:
         sb.pipeline = {
             k: round(sum(d.get("pipe." + k, 0) for d in deltas), 1)
             for k in _PIPE_KEYS
+        }
+        sb.aggregate = {
+            k: round(sum(d.get("agg." + k, 0) for d in deltas), 1)
+            for k in _AGG_KEYS
         }
         return sb
 

@@ -1107,11 +1107,22 @@ _MISSING_ARM = object()
 
 
 def _field_path_of(codec: XdrCodec, path) -> tuple:
+    """(C steps, normalized path, terminal-is-union) for `path`.  A path
+    may TERMINATE at a union: it then addresses the DISCRIMINANT (read as
+    a plain int, never settable) — the hot statement-type accessor shape
+    (``xdr_getfield(SCPEnvelope, raw, ("statement", "pledges"))``)."""
     norm = _normalize_field_path(path)
     key = (id(codec), norm)
     hit = _FIELD_PATH_MEMO.get(key)
     if hit is None:
-        hit = (_resolve_field_path(codec, norm)[0], norm)
+        steps, terminal = _resolve_field_path(codec, norm)
+        while isinstance(terminal, (DepthLimited, _Option)):
+            terminal = (
+                terminal.inner
+                if isinstance(terminal, DepthLimited)
+                else terminal.elem
+            )
+        hit = (steps, norm, isinstance(terminal, _UnionCodec))
         _FIELD_PATH_MEMO[key] = hit
     return hit
 
@@ -1161,11 +1172,16 @@ def xdr_getfield(cls_or_codec, data: bytes, path):
     codec = cls_or_codec if isinstance(cls_or_codec, XdrCodec) else codec_of(
         cls_or_codec
     )
-    steps, norm = _field_path_of(codec, path)
+    steps, norm, union_terminal = _field_path_of(codec, path)
     prog = _cprog_for(codec)
     if prog is not False:
         return _cxdr().getfield(prog, data, steps)
-    return _py_walk(codec.unpack(data), norm)
+    obj = _py_walk(codec.unpack(data), norm)
+    if union_terminal:
+        # parity with the C walker: a terminal union reads as its
+        # discriminant (plain int), None behind an absent option
+        return None if obj is None else int(obj.type)
+    return obj
 
 
 def xdr_setfield(cls_or_codec, data: bytes, path, value) -> bytes:
@@ -1176,7 +1192,11 @@ def xdr_setfield(cls_or_codec, data: bytes, path, value) -> bytes:
     codec = cls_or_codec if isinstance(cls_or_codec, XdrCodec) else codec_of(
         cls_or_codec
     )
-    steps, norm = _field_path_of(codec, path)
+    steps, norm, union_terminal = _field_path_of(codec, path)
+    if union_terminal:
+        # patching a discriminant would change which arm follows (and
+        # usually the value's length) — not a fixed-width scalar patch
+        raise XdrError("cannot set a union discriminant")
     prog = _cprog_for(codec)
     if prog is not False:
         return _cxdr().setfield(prog, data, steps, value)

@@ -400,6 +400,98 @@ def test_getfield_absent_option_is_none():
     assert xdr_getfield(AccountEntry, data, "inflationDest") is None
 
 
+def test_getfield_terminal_union_discriminant():
+    """A path TERMINATING at a union reads its discriminant as a plain
+    int (ISSUE r15: the herder's post-verify statement-type hot read) —
+    C walker and decoded-object oracle agree for every statement type,
+    truncation raises, and setfield refuses the discriminant."""
+    from stellar_tpu.xdr.base import XdrError, xdr_getfield, xdr_setfield
+    from stellar_tpu.xdr.scp import (
+        SCPBallot,
+        SCPEnvelope,
+        SCPNomination,
+        SCPStatement,
+        SCPStatementConfirm,
+        SCPStatementPledges,
+        SCPStatementType,
+    )
+    from stellar_tpu.xdr.xtypes import PublicKey
+
+    def envelope_for(t):
+        if t == SCPStatementType.SCP_ST_NOMINATE:
+            pledges = SCPStatementPledges(
+                t, SCPNomination(b"\x02" * 32, [b"vote"], [])
+            )
+        else:
+            pledges = SCPStatementPledges(
+                t,
+                SCPStatementConfirm(
+                    b"\x11" * 32, 1, SCPBallot(1, b"v"), 1
+                ),
+            )
+        return SCPEnvelope(
+            statement=SCPStatement(
+                nodeID=PublicKey.from_ed25519(b"\x01" * 32),
+                slotIndex=42,
+                pledges=pledges,
+            ),
+            signature=b"\x03" * 64,
+        )
+
+    for t in (
+        SCPStatementType.SCP_ST_CONFIRM,
+        SCPStatementType.SCP_ST_NOMINATE,
+    ):
+        env = envelope_for(t)
+        raw = env.to_xdr()
+        got = xdr_getfield(SCPEnvelope, raw, ("statement", "pledges"))
+        assert got == int(env.statement.pledges.type) == int(t)
+        # nodeID is a union too (key type); and the scalar neighbor reads
+        assert xdr_getfield(SCPEnvelope, raw, ("statement", "nodeID")) == 0
+        assert xdr_getfield(SCPEnvelope, raw, "statement.slotIndex") == 42
+        with pytest.raises(XdrError):
+            xdr_getfield(SCPEnvelope, raw[:40], ("statement", "pledges"))
+        with pytest.raises(XdrError, match="discriminant"):
+            xdr_setfield(SCPEnvelope, raw, ("statement", "pledges"), 1)
+
+
+def test_getfield_terminal_union_python_walk_parity():
+    """The Python fallback resolution marks terminal-union paths and
+    would return int(obj.type) — same value the C walker reads."""
+    from stellar_tpu.xdr import base as B
+    from stellar_tpu.xdr.base import codec_of
+    from stellar_tpu.xdr.scp import (
+        SCPEnvelope,
+        SCPNomination,
+        SCPStatement,
+        SCPStatementPledges,
+        SCPStatementType,
+    )
+    from stellar_tpu.xdr.xtypes import PublicKey
+
+    env = SCPEnvelope(
+        statement=SCPStatement(
+            nodeID=PublicKey.from_ed25519(b"\x01" * 32),
+            slotIndex=7,
+            pledges=SCPStatementPledges(
+                SCPStatementType.SCP_ST_NOMINATE,
+                SCPNomination(b"\x02" * 32, [], []),
+            ),
+        ),
+        signature=b"\x03" * 64,
+    )
+    codec = codec_of(SCPEnvelope)
+    steps, norm, union_terminal = B._field_path_of(
+        codec, ("statement", "pledges")
+    )
+    assert union_terminal
+    obj = B._py_walk(codec.unpack(env.to_xdr()), norm)
+    assert int(obj.type) == int(SCPStatementType.SCP_ST_NOMINATE)
+    # scalar paths stay non-union
+    _, _, ut = B._field_path_of(codec, "statement.slotIndex")
+    assert not ut
+
+
 def test_setfield_differential_vs_repack():
     """Patching a fixed-width scalar in the bytes must equal setattr +
     full repack, for every fixed-width path of a fuzzed LedgerEntry."""
@@ -413,7 +505,7 @@ def test_setfield_differential_vs_repack():
         val = arbitrary.arbitrary(codec, size=6, rng=rng)
         data = _py_pack(codec, val)
         for path, _old in _scalar_paths_of(codec, val):
-            steps, norm = B._field_path_of(codec, path)
+            steps, norm, _union = B._field_path_of(codec, path)
             _, leaf = B._resolve_field_path(codec, norm)
             if isinstance(leaf, B._UInt32):
                 new = rng.getrandbits(32)

@@ -2,7 +2,7 @@
 
 A fresh checkout carries only the .c sources — the .so files are built on
 first use.  Until now that path was only validated by hand (PROFILE.md
-round-5 "cold-clone validation"); this builds all THREE extensions from
+round-5 "cold-clone validation"); this builds all FOUR extensions from
 source in a temp dir with the system toolchain and runs a smoke
 differential of each against the checked-in/loaded behavior, so a
 toolchain or source regression that would only bite a cold clone fails
@@ -27,7 +27,7 @@ pytestmark = pytest.mark.skipif(
 def cold_dir(tmp_path_factory):
     d = tmp_path_factory.mktemp("coldbuild")
     src_dir = os.path.dirname(os.path.abspath(native.__file__))
-    for name in ("bucketmerge.c", "cxdrpack.c", "sighash.c"):
+    for name in ("bucketmerge.c", "cxdrpack.c", "sighash.c", "halfagg.c"):
         shutil.copy(os.path.join(src_dir, name), str(d / name))
     return d
 
@@ -178,6 +178,38 @@ try:
 except (ValueError, TypeError):
     pass
 
+# -- halfagg: decompress/msm on hostile + structured points ----------------
+agg_mod = native.load_halfagg()
+assert agg_mod is not None, "halfagg failed to build sanitized"
+B_enc = ref.compress(ref.base_point())
+pts = [B_enc]
+for i in range(40):
+    pts.append(bytes(rng.randrange(256) for _ in range(32)))
+pts += [b"\x00" * 32, b"\x01" + b"\x00" * 31, b"\xff" * 32]
+okf, ext = agg_mod.decompress(b"".join(pts))
+assert okf[0] == 1
+good = [ext[i * 160 : (i + 1) * 160] for i in range(len(pts)) if okf[i]]
+scalars = b"".join(
+    (rng.randrange(ref.L)).to_bytes(32, "little") for _ in good
+)
+out32 = agg_mod.msm_ext(b"".join(good), scalars)
+assert len(out32) == 32
+# malformed limb blobs must raise, never overflow the accumulators
+try:
+    agg_mod.msm_ext(b"\xff" * 160, b"\x01" + b"\x00" * 31)
+except ValueError:
+    pass
+else:
+    raise SystemExit("msm_ext accepted out-of-bound limbs")
+# short/ragged buffers raise cleanly
+for bad in (b"\x01" * 31, b"\x01" * 33):
+    try:
+        agg_mod.msm(bad, b"\x00" * 32)
+    except ValueError:
+        pass
+    else:
+        raise SystemExit("msm accepted a ragged buffer")
+
 # -- sodium pool leg (skipped silently when libsodium is absent) -----------
 try:
     from stellar_tpu.crypto import sodium
@@ -196,7 +228,7 @@ print("SAN_OK")
 
 @pytest.mark.slow
 def test_sanitized_build_differentials():
-    """ASan+UBSan leg: rebuild all three extensions with
+    """ASan+UBSan leg: rebuild all four extensions with
     -fsanitize=address,undefined (the STELLAR_TPU_SANITIZE plumb-through,
     separate .san.so artifacts) and run the hostile/truncated-input
     differentials inside a driver subprocess with the sanitizer runtimes
@@ -232,6 +264,31 @@ def test_sanitized_build_differentials():
         f"{p.stdout[-4000:]}\n--- stderr ---\n{p.stderr[-4000:]}"
     )
     assert "SAN_OK" in p.stdout
+
+
+def test_halfagg_cold_build_msm_differential(cold_dir):
+    cold = native._load_extension(
+        "_halfagg", str(cold_dir / "halfagg.c"),
+        str(cold_dir / "_halfagg.so"),
+    )
+    assert cold is not None, "halfagg.c failed to compile from source"
+    import random
+
+    from stellar_tpu.ops import ref25519 as ref
+
+    rng = random.Random(5)
+    B = ref.base_point()
+    pts, scs, expect = [], [], ref.IDENT
+    for _ in range(9):
+        pt = ref.scalar_mult(rng.randrange(1, ref.L), B)
+        s = rng.randrange(ref.L)
+        pts.append(ref.compress(pt))
+        scs.append(s.to_bytes(32, "little"))
+        expect = ref.point_add(expect, ref.scalar_mult(s, pt))
+    out = cold.msm(b"".join(pts), b"".join(scs))
+    assert out == ref.compress(expect)
+    warm = native.load_halfagg()
+    assert warm.msm(b"".join(pts), b"".join(scs)) == out
 
 
 def test_sighash_cold_build_stage_differential(cold_dir):
