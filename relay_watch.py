@@ -136,14 +136,16 @@ FINAL_STEPS = [
     # r15: aggregate-signature envelope leg — the same-slot ballot-storm
     # pairing (half-aggregation MSM check vs per-envelope libsodium on
     # the identical >=1024-envelope fixture) re-certified in a green
-    # window; relay-independent, but green-window-paired so the committed
-    # speedup rides a quiet host.  Exits nonzero when the aggregate leg
-    # stops beating the per-envelope leg.
+    # window.  Post-review (mixed-torsion soundness fix) the sound CPU
+    # path measures ~0.92x: the fresh-R prime-order proof costs ~one
+    # scalar-mult per envelope — the price of cofactorless bit-parity —
+    # so this step is a cost-regression gate (>= 0.80x) until the
+    # R-column proof offloads to the TPU batch plane (ROADMAP lead).
     ("aggregate_envelope_r15",
      [sys.executable, "-u", "-c",
       "import json, bench; r = bench.bench_scp_envelope_aggregate(); "
       "print(json.dumps(r)); "
-      "assert r['speedup_vs_per_envelope'] > 1.0, r"],
+      "assert r['speedup_vs_per_envelope'] >= 0.80, r"],
      900),
 ]
 ALL_NAMES = (

@@ -18,9 +18,17 @@ challenge and the ``z_i`` are Fiat-Shamir coefficients bound to the WHOLE
 statement list (every R, A and message hash feeds the transcript root), so
 splicing a signature between lists, reordering, or tampering with s̄ all
 break the equation.  ``z_i`` are 128-bit: forging an aggregate over an
-invalid item means hitting a 2^-128 linear relation, the same soundness
-level libsodium-style batch verification uses — and the half-width scalars
-halve the R-column's share of the MSM.
+invalid item means hitting a 2^-128 linear relation — *in the prime-order
+subgroup*.  The 8-torsion subgroup sees only ``z_i mod 8``: a defect that
+is pure torsion (a mixed-torsion A or a mauled R = R₀ + T) survives the
+MSM whenever the coefficients conspire mod 8 — grindable Fiat-Shamir odds
+of 1/8 per transcript, exactly the failure PROFILE.md's round-3 batch-RLC
+note documents.  Soundness therefore additionally requires every A_i and
+R_i PROVEN in the prime-order subgroup ([L]·P == identity, ``torsion_free``
+in the native engine / ``ref25519.is_torsion_free``).  The proof costs
+~one scalar multiplication per point: amortized to zero for validator
+keys through the PointCache, paid once per fresh R — the irreducible
+price of bit-parity with a cofactorless reference verifier.
 
 Completeness is exact, not probabilistic: if every item passes libsodium's
 ``crypto_sign_verify_detached`` (byte-compared R), then each
@@ -118,6 +126,12 @@ def aggregate(items: Sequence[VerifyTriple]) -> bytes:
     """Half-aggregate: R_1‖…‖R_n‖s̄ (32n + 32 bytes).  Pure scalar work —
     no point operation; aggregation is cheap, verification carries the
     curve math."""
+    for pk, _msg, sig in items:
+        if len(pk) != 32 or len(sig) != 64:
+            raise ValueError(
+                "halfagg aggregate needs 32-byte pubkeys and 64-byte "
+                f"signatures (got pk={len(pk)}, sig={len(sig)})"
+            )
     pks = [it[0] for it in items]
     msgs = [it[1] for it in items]
     rs = [it[2][:32] for it in items]
@@ -129,12 +143,15 @@ def aggregate(items: Sequence[VerifyTriple]) -> bytes:
 
 
 class PointCache:
-    """Bounded LRU of strict-decoded points keyed by their compressed
-    encoding — the validator-key (A_i) memo.  Values are the native
-    extended-limb blob, or the ref25519 coordinate tuple on toolchain-less
-    hosts; ``None`` records a PERMANENT decode failure (a malformed key
-    stays malformed — negative caching keeps a hostile peer from making
-    the node re-derive the same failed square root every slot)."""
+    """Bounded LRU of strict-decoded, PRIME-ORDER-PROVEN points keyed by
+    their compressed encoding — the validator-key (A_i) memo.  Values are
+    the native extended-limb blob, or the ref25519 coordinate tuple on
+    toolchain-less hosts; ``None`` records a PERMANENT unusability:
+    undecodable, or decodable but outside the prime-order subgroup (a
+    mixed-torsion key would defeat the cofactorless MSM's soundness).
+    Both properties are intrinsic to the encoding, so the negative cache
+    keeps a hostile peer from making the node re-derive the same failed
+    square root — or re-run the same [L]·P ladder — every slot."""
 
     def __init__(self, capacity: int = 0x10000):
         self.capacity = capacity
@@ -165,10 +182,22 @@ class PointCache:
             return len(self._map)
 
 
-def _decompress_many(encs: Sequence[bytes], cache: Optional[PointCache]):
+def _decompress_many(
+    encs: Sequence[bytes],
+    cache: Optional[PointCache],
+    check_torsion: bool = True,
+):
     """Strict-decode a point column, through the cache when given.
     Returns a list of native ext blobs / ref tuples, with None for
-    undecodable encodings."""
+    unusable encodings — undecodable, or (with ``check_torsion``, the
+    default) outside the prime-order subgroup.  ``check_torsion=False``
+    defers the [L]·P proof to the caller (the R column runs it only
+    after the MSM passes, so a poisoned bucket skips it) and is only
+    valid with ``cache=None`` — the cache stores proven points."""
+    if not check_torsion and cache is not None:
+        raise ValueError(
+            "check_torsion=False would cache torsion-unproven points"
+        )
     mod = _native()
     vals = cache.get_many(encs) if cache is not None else [False] * len(encs)
     miss = [i for i, v in enumerate(vals) if v is False]
@@ -190,9 +219,31 @@ def _decompress_many(encs: Sequence[bytes], cache: Optional[PointCache]):
                     else None
                 )
                 vals[i] = pt
+        if check_torsion:
+            decoded = [i for i in miss if vals[i] is not None]
+            if decoded:
+                free = _torsion_free_many([vals[i] for i in decoded])
+                for i, tf in zip(decoded, free):
+                    if not tf:
+                        vals[i] = None
         if cache is not None:
             cache.put_many((encs[i], vals[i]) for i in miss)
     return vals
+
+
+def _torsion_free_many(vals: Sequence) -> List[bool]:
+    """Prime-order-subgroup proof per decoded point ([L]·P == identity).
+    ``vals`` are non-None values from ``_decompress_many`` — native ext
+    blobs or ref tuples.  See the module docstring: the cofactorless MSM
+    alone has only 1/8 soundness against torsion components, so every
+    point the aggregate plane trusts must pass this."""
+    mod = _native()
+    if not vals:
+        return []
+    if mod is not None and isinstance(vals[0], bytes):
+        ok = mod.torsion_free(b"".join(vals))
+        return [bool(b) for b in ok]
+    return [ref.is_torsion_free(v) for v in vals]
 
 
 def _msm_is_identity(points, scalars: Sequence[int]) -> bool:
@@ -217,7 +268,11 @@ def verify_aggregated(
     """Verify a half-aggregate certificate against its statement list.
     True ⇒ every (A_i, m_i) carries a signature libsodium would accept
     (up to the 2^-128 batch-soundness bound); any tampered R, spliced
-    item, reordered list, or forged s̄ fails."""
+    item, reordered list, or forged s̄ fails.  The accept set is further
+    restricted to prime-order A_i and R_i (honest signers never produce
+    anything else): a mixed-torsion point would cut the MSM's soundness
+    to 1/8, so it is rejected outright — the certificate API has no
+    per-item fallback to shelter it."""
     n = len(pks)
     if len(msgs) != n or len(aggsig) != 32 * n + 32:
         return False
@@ -239,7 +294,7 @@ def verify_aggregated(
     if n == 0:
         return s_bar == 0
     a_pts = _decompress_many(list(pks), point_cache)
-    r_pts = _decompress_many(rs, None)
+    r_pts = _decompress_many(rs, None, check_torsion=False)
     if any(p is None for p in a_pts) or any(p is None for p in r_pts):
         return False
     zs = coefficients(transcript_root(pks, msgs, rs), n)
@@ -249,7 +304,12 @@ def verify_aggregated(
     scalars = [(L - s_bar) % L] + zs + [
         (z * h) % L for z, h in zip(zs, hs)
     ]
-    return _msm_is_identity(points, scalars)
+    if not _msm_is_identity(points, scalars):
+        return False
+    # the MSM is blind to torsion whenever the z_i conspire mod 8; only
+    # a prime-order proof of the fresh R column closes the 1/8 hole (the
+    # A column was proven inside _decompress_many, cached)
+    return all(_torsion_free_many(r_pts))
 
 
 _BASE_ENC = ref.compress(ref.base_point())
@@ -278,8 +338,10 @@ def verify_batch_aggregated(
             if len(sig) != 64 or not ref.agg_input_ok(pk, sig):
                 return False
     a_pts = _decompress_many(pks, point_cache)
-    r_pts = _decompress_many(rs, None)
-    if any(p is None for p in a_pts) or any(p is None for p in r_pts):
+    if any(p is None for p in a_pts):
+        return False
+    r_pts = _decompress_many(rs, None, check_torsion=False)
+    if any(p is None for p in r_pts):
         return False
     zs = coefficients(transcript_root(pks, msgs, rs), n)
     hs = [challenge(pk, msg, r) for pk, msg, r in zip(pks, msgs, rs)]
@@ -291,4 +353,9 @@ def verify_batch_aggregated(
     scalars = [(L - s_bar) % L] + zs + [
         (z * h) % L for z, h in zip(zs, hs)
     ]
-    return _msm_is_identity(points, scalars)
+    if not _msm_is_identity(points, scalars):
+        return False
+    # cofactorless-MSM pass alone is 1/8-sound against a mauled R = R₀+T;
+    # only latch-grade once every fresh R is proven prime-order (A column
+    # proven via the cache in _decompress_many; B is prime-order)
+    return all(_torsion_free_many(r_pts))

@@ -14,12 +14,18 @@ batch flush:
   verifies each bucket with ONE half-aggregation MSM check
   (crypto/aggregate/halfagg.py) instead of one batch lane per signature.
   A bucket whose aggregate check fails — any invalid signature, hostile
-  point, 2^-128 bad luck — FALLS BACK to the per-envelope SigBackend for
-  that bucket, so per-item verdicts are always bit-identical to the
-  reference path: honest buckets pay one aggregate check, poisoned
-  buckets pay aggregate + the reference cost (arXiv:2302.00418's
-  speculative-aggregate-verify shape; the TPU batch plane stays the
-  non-aggregatable fallback per arXiv:2604.17808).
+  point (including a mixed-torsion A or R, against which the cofactorless
+  MSM alone would only be 1/8-sound; halfagg.py proves every trusted
+  point prime-order), 2^-128 bad luck — FALLS BACK to the per-envelope
+  SigBackend for that bucket, so per-item verdicts are always
+  bit-identical to the reference path: honest buckets pay one aggregate
+  check, poisoned buckets pay aggregate + the reference cost
+  (arXiv:2302.00418's speculative-aggregate-verify shape; the TPU batch
+  plane stays the non-aggregatable fallback per arXiv:2604.17808).
+  Items whose pubkey is negative-cached as permanently unusable
+  (undecodable or torsioned — properties libsodium itself may tolerate
+  on crafted signatures) are routed per-item BEFORE bucketing, so one
+  hostile key poisons a bucket only on first sight.
 
 Cache contract: both schemes latch VALID verdicts only into the shared
 verify cache (the flood-defense latch contract, PR 8).  The aggregate
@@ -119,6 +125,7 @@ class HalfAggScheme(ScpSigScheme):
         self.n_fallback_envelopes = 0
         self.n_gate_rejects = 0
         self.n_small_buckets = 0
+        self.n_unaggregatable = 0  # negative-cached A: per-item, pre-bucket
 
     def verify_flush(
         self, items: Sequence[VerifyTriple], slots: Sequence[int]
@@ -141,7 +148,7 @@ class HalfAggScheme(ScpSigScheme):
             if v is None:
                 buckets.setdefault(slots[i], []).append(i)
         fallback: List[int] = []
-        n_checks = n_passed = n_agg = n_gate = n_small = 0
+        n_checks = n_passed = n_agg = n_gate = n_small = n_unagg = 0
         for slot, idxs in buckets.items():
             if len(idxs) < self.MIN_AGG:
                 n_small += len(idxs)
@@ -155,6 +162,20 @@ class HalfAggScheme(ScpSigScheme):
                     verdicts[i] = False
                     n_gate += 1
             eligible = [i for i, ok in zip(idxs, gate_ok) if ok]
+            # pubkeys negative-cached as permanently unusable (undecodable
+            # or torsioned) can never aggregate but CAN carry signatures
+            # libsodium accepts — per-item verdicts, without letting one
+            # such key poison this bucket every flush
+            a_vals = self.point_cache.get_many(
+                [items[i][0] for i in eligible]
+            )
+            bad_a = [i for i, v in zip(eligible, a_vals) if v is None]
+            if bad_a:
+                n_unagg += len(bad_a)
+                fallback.extend(bad_a)
+                eligible = [
+                    i for i, v in zip(eligible, a_vals) if v is not None
+                ]
             if len(eligible) < self.MIN_AGG:
                 n_small += len(eligible)
                 fallback.extend(eligible)
@@ -171,7 +192,9 @@ class HalfAggScheme(ScpSigScheme):
                     verdicts[i] = True
                 # valid-only latch, synchronously on the caller's thread:
                 # the aggregate check just proved every one of these
-                # signatures libsodium-valid (completeness is exact), and
+                # signatures libsodium-valid (completeness is exact, and
+                # soundness is 2^-128 because every A and fresh R was
+                # proven prime-order before the MSM verdict counts), so
                 # invalid items can never reach this line — the bounded
                 # LRU stays un-pollutable under flood exactly like the
                 # reference path
@@ -193,6 +216,7 @@ class HalfAggScheme(ScpSigScheme):
         self.n_agg_envelopes += n_agg
         self.n_gate_rejects += n_gate
         self.n_small_buckets += n_small
+        self.n_unaggregatable += n_unagg
         self._tracer.end(
             sp,
             batch=n,
@@ -239,6 +263,7 @@ class HalfAggScheme(ScpSigScheme):
             "fallback_envelopes": self.n_fallback_envelopes,
             "gate_rejects": self.n_gate_rejects,
             "small_bucket_envelopes": self.n_small_buckets,
+            "unaggregatable_envelopes": self.n_unaggregatable,
             "point_cache_entries": len(self.point_cache),
             "native_msm": halfagg.native_available(),
         }

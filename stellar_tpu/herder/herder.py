@@ -16,6 +16,7 @@ TxSetFrame.check_valid).
 
 from __future__ import annotations
 
+import heapq
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -159,6 +160,14 @@ class Herder(SCPDriver):
         # traffic clusters at the bracket's low end.
         self.scp_slot_buckets: Dict[int, Dict[int, int]] = {}
         self.MAX_SLOT_BUCKETS = 1024
+        # lazy-deletion max-heap (negated slots) over scp_slot_buckets:
+        # the at-cap evict decision is O(log n) per envelope instead of a
+        # max() scan over 1024 keys — the scan would sit on exactly the
+        # flood path the cap defends (valid-sig envelopes with arbitrary
+        # fresh far-future slots).  Entries for slots trimmed elsewhere
+        # (slot_closed) go stale in place and are popped when they
+        # surface; a periodic rebuild bounds the stale mass.
+        self._slot_bucket_heap: List[int] = []
 
         m = app.metrics
         self.m_envelope_sign = m.new_meter(("scp", "envelope", "sign"), "envelope")
@@ -746,16 +755,47 @@ class Herder(SCPDriver):
         if meter is not None:
             meter.mark()
         bucket = self.scp_slot_buckets.get(slot)
-        if bucket is None and len(self.scp_slot_buckets) >= self.MAX_SLOT_BUCKETS:
-            evict = max(self.scp_slot_buckets)
-            if slot < evict:
-                del self.scp_slot_buckets[evict]
+        if bucket is None:
+            make = True
+            if len(self.scp_slot_buckets) >= self.MAX_SLOT_BUCKETS:
+                evict = self._slot_bucket_max()
+                if evict is not None and slot < evict:
+                    del self.scp_slot_buckets[evict]
+                    heapq.heappop(self._slot_bucket_heap)
+                else:
+                    make = False  # farther than everything tracked
+            if make:
                 bucket = self.scp_slot_buckets.setdefault(slot, {})
-        elif bucket is None:
-            bucket = self.scp_slot_buckets.setdefault(slot, {})
+                heapq.heappush(self._slot_bucket_heap, -slot)
+                # stale entries from slot_closed trims accrue even far
+                # below cap (one per closed slot, forever)
+                self._maybe_rebuild_slot_bucket_heap()
         if bucket is not None:
             bucket[stype] = bucket.get(stype, 0) + 1
         self.pending_envelopes.recv_scp_envelope(envelope, raw=raw)
+
+    def _maybe_rebuild_slot_bucket_heap(self) -> None:
+        """Rebuild the lazy heap when stale entries outnumber live ones
+        ~3:1 — the bound is relative to LIVE size (not the cap) so a
+        healthy below-cap node's per-closed-slot stale entries can never
+        accumulate; amortized O(1) over the pushes that grew it."""
+        heap = self._slot_bucket_heap
+        if len(heap) > 4 * max(len(self.scp_slot_buckets), 16):
+            heap[:] = [-s for s in self.scp_slot_buckets]
+            heapq.heapify(heap)
+
+    def _slot_bucket_max(self) -> Optional[int]:
+        """Largest slot currently tracked in scp_slot_buckets, via the
+        lazy-deletion heap: stale tops (slots trimmed by slot_closed)
+        pop here; amortized cost O(log n) per envelope."""
+        self._maybe_rebuild_slot_bucket_heap()
+        heap = self._slot_bucket_heap
+        while heap:
+            s = -heap[0]
+            if s in self.scp_slot_buckets:
+                return s
+            heapq.heappop(heap)
+        return None
 
     def note_envelope_rejected(self, envelope: SCPEnvelope) -> None:
         """The overlay's batch flush verified this envelope's signature

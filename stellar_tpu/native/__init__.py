@@ -282,8 +282,14 @@ def load_halfagg():
         if _halfagg_mod is not None or _halfagg_tried:
             return _halfagg_mod
         _halfagg_tried = True
+        # -O3 after the default -O2 (last flag wins): the [L]P torsion
+        # ladder and Pippenger loops are tight fe-limb arithmetic that
+        # measurably benefits from the extra unrolling.  NOT in sanitizer
+        # builds — it would also out-rank _san_flags()' deliberate -O1
+        # and degrade ASan/UBSan report fidelity.
+        flags = () if sanitize_mode() else ("-O3",)
         _halfagg_mod = _load_extension(
-            "_halfagg", _HALFAGG_SRC, _san_so(_HALFAGG_SO)
+            "_halfagg", _HALFAGG_SRC, _san_so(_HALFAGG_SO), flags
         )
         return _halfagg_mod
 

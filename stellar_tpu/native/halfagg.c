@@ -22,6 +22,15 @@
  *     complete twisted-Edwards addition law — ~60k point additions for a
  *     2000-point slot vs ~500k point operations for 1000 independent
  *     verifies.  Scalars arrive already reduced mod L (32-byte LE).
+ *   - ``torsion_free``: batch prime-order-subgroup proof, [L]·P ==
+ *     identity per point.  The cofactorless MSM check alone has only
+ *     1/8 soundness against mixed-torsion inputs (a defect that is pure
+ *     8-torsion survives whenever the Fiat-Shamir z_i conspire mod 8 —
+ *     the exact failure PROFILE.md's round-3 batch-RLC note documents),
+ *     so the aggregate plane only trusts an MSM pass over points proven
+ *     prime-order.  The proof costs ~one scalar multiplication per
+ *     point — amortized to zero for validator keys (PointCache), paid
+ *     once per fresh R.
  *
  * Field arithmetic is 5×51-bit limbs with __uint128_t accumulation
  * (curve25519-donna shape), written from RFC 7748/8032 and the curve
@@ -132,7 +141,33 @@ static void fe_mul(fe h, const fe f, const fe g)
     h[0] = r0; h[1] = r1; h[2] = r2; h[3] = r3; h[4] = r4;
 }
 
-static void fe_sq(fe h, const fe f) { fe_mul(h, f, f); }
+/* h = f^2; inputs < 2^54, output < 2^52 — the doubled-cross-term
+ * squaring (15 limb products vs fe_mul's 25); pow22523/fe_pow and the
+ * doubling ladder are squaring-dominated, so this is ~30% of their cost */
+static void fe_sq(fe h, const fe f)
+{
+    u128 t0, t1, t2, t3, t4;
+    uint64_t f0 = f[0], f1 = f[1], f2 = f[2], f3 = f[3], f4 = f[4];
+    uint64_t f0_2 = f0 * 2, f1_2 = f1 * 2;
+    uint64_t f1_38 = 38 * f1, f2_38 = 38 * f2, f3_38 = 38 * f3,
+             f3_19 = 19 * f3, f4_19 = 19 * f4;
+
+    t0 = (u128)f0 * f0 + (u128)f1_38 * f4 + (u128)f2_38 * f3;
+    t1 = (u128)f0_2 * f1 + (u128)f2_38 * f4 + (u128)f3_19 * f3;
+    t2 = (u128)f0_2 * f2 + (u128)f1 * f1 + (u128)f3_38 * f4;
+    t3 = (u128)f0_2 * f3 + (u128)f1_2 * f2 + (u128)f4_19 * f4;
+    t4 = (u128)f0_2 * f4 + (u128)f1_2 * f3 + (u128)f2 * f2;
+
+    uint64_t r0, r1, r2, r3, r4, c;
+    r0 = (uint64_t)t0 & M51; t1 += (uint64_t)(t0 >> 51);
+    r1 = (uint64_t)t1 & M51; t2 += (uint64_t)(t1 >> 51);
+    r2 = (uint64_t)t2 & M51; t3 += (uint64_t)(t2 >> 51);
+    r3 = (uint64_t)t3 & M51; t4 += (uint64_t)(t3 >> 51);
+    r4 = (uint64_t)t4 & M51;
+    r0 += 19 * (uint64_t)(t4 >> 51);
+    c = r0 >> 51; r0 &= M51; r1 += c;
+    h[0] = r0; h[1] = r1; h[2] = r2; h[3] = r3; h[4] = r4;
+}
 
 /* generic square-and-multiply; exponent public (verifier-only module) */
 static void fe_pow(fe out, const fe base, const uint8_t exp[32])
@@ -310,6 +345,117 @@ static void ge_add(ge *r, const ge *p, const ge *q)
     fe_mul(r->T, e, h);
 }
 
+/* dedicated doubling (dbl-2008-hwcd, a=-1, sign-normalized so every
+ * intermediate stays non-negative): A=X^2 B=Y^2 C=2Z^2 E=(X+Y)^2-A-B
+ * G=B-A F=C-G H=A+B ; X3=EF Y3=GH Z3=FG T3=EH — 4 squarings + 4 muls
+ * vs ge_add's 9 muls; the [L]P ladder is 252 of these per point */
+static void ge_dbl(ge *r, const ge *p)
+{
+    fe A, B, C, E, F, G, H, t;
+
+    fe_sq(A, p->X);
+    fe_sq(B, p->Y);
+    fe_sq(C, p->Z);
+    fe_add(C, C, C);
+    fe_add(t, p->X, p->Y);
+    fe_sq(E, t);
+    fe_sub(E, E, A);
+    fe_carry(E);
+    fe_sub(E, E, B);
+    fe_carry(E);
+    fe_sub(G, B, A);
+    fe_carry(G);
+    fe_sub(F, C, G);
+    fe_carry(F);
+    fe_add(H, A, B);
+    fe_mul(r->X, E, F);
+    fe_mul(r->Y, G, H);
+    fe_mul(r->Z, F, G);
+    fe_mul(r->T, E, H);
+}
+
+/* T-less doubling for doubling-only runs (dbl-2008-bbjlp shape, a=-1,
+ * globally negated so every operand stays non-negative): 3M+4S vs
+ * ge_dbl's 4M+4S.  Leaves p->T stale — callers must finish a run with
+ * ge_dbl before the next ge_add. */
+static void ge_dbl_p2(ge *r, const ge *p)
+{
+    fe B, C, D, G, H2, J, t;
+
+    fe_add(t, p->X, p->Y);
+    fe_sq(B, t);
+    fe_sq(C, p->X);
+    fe_sq(D, p->Y);
+    fe_sq(H2, p->Z);
+    fe_add(H2, H2, H2);
+    fe_sub(G, D, C);            /* G = D - C  (= F in the EFD notes) */
+    fe_carry(G);
+    fe_sub(t, B, C);
+    fe_carry(t);
+    fe_sub(t, t, D);            /* t = B - C - D */
+    fe_carry(t);
+    fe_add(J, C, H2);
+    fe_carry(J);
+    fe_sub(J, J, D);            /* J = C + 2Z^2 - D (= -J in the notes) */
+    fe_carry(J);
+    fe_add(H2, C, D);           /* reuse: C + D */
+    fe_mul(r->X, t, J);
+    fe_mul(r->Y, G, H2);
+    fe_mul(r->Z, G, J);
+}
+
+/* identity in extended coords: X = 0 and Y = Z (the other X=0 point,
+ * (0,-1) of order 2, has Y = -Z and fails fe_eq) */
+static int ge_is_ident(const ge *p)
+{
+    return fe_iszero(p->X) && fe_eq(p->Y, p->Z);
+}
+
+/* L = 2^252 + 27742317777372353535851937790883648493, little-endian —
+ * the prime subgroup order */
+static const uint8_t L_LE[32] = {
+    0xed, 0xd3, 0xf5, 0x5c, 0x1a, 0x63, 0x12, 0x58, 0xd6, 0x9c, 0xf7,
+    0xa2, 0xde, 0xf9, 0xde, 0x14, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00,
+    0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x10};
+
+/* prime-order-subgroup membership: [L]P == identity.  Fixed 4-bit
+ * windows over the fixed scalar L: 252 doublings + ~45 additions (L's
+ * nibbles 32..62 are zero, so the middle of the ladder is doubling-only).
+ * ~one scalar multiplication per point — see the module header for why
+ * nothing cheaper can be sound against the 8-torsion subgroup. */
+static int ge_torsion_free(const ge *p)
+{
+    ge tbl[15], acc;
+    tbl[0] = *p;
+    for (int m = 2; m <= 15; m++) {
+        if (m & 1)
+            ge_add(&tbl[m - 1], &tbl[m - 2], p);
+        else
+            ge_dbl(&tbl[m - 1], &tbl[m / 2 - 1]);
+    }
+    ge_ident(&acc);
+    int started = 0;
+    for (int w = 63; w >= 0; w--) {
+        unsigned d = (L_LE[w >> 1] >> ((w & 1) ? 4 : 0)) & 0xfu;
+        if (started) {
+            /* T-less doublings except when this window ends in an add
+             * (ge_add is the only consumer of T; ge_is_ident is not) */
+            ge_dbl_p2(&acc, &acc);
+            ge_dbl_p2(&acc, &acc);
+            ge_dbl_p2(&acc, &acc);
+            if (d)
+                ge_dbl(&acc, &acc);
+            else
+                ge_dbl_p2(&acc, &acc);
+        }
+        if (d) {
+            ge_add(&acc, &acc, &tbl[d - 1]);
+            started = 1;
+        }
+    }
+    return ge_is_ident(&acc);
+}
+
 /* RFC 8032 §5.1.3 strict decode; returns 1 ok, 0 reject.  Stricter than
  * ref10's permissive fe_frombytes: a non-canonical y (>= p) is rejected
  * here — libsodium's byte-compare verify can never accept such an R and
@@ -458,7 +604,7 @@ static void msm_run(uint8_t out[32], const ge *pts, const uint8_t *scalars,
     for (int w = n_windows - 1; w >= 0; w--) {
         if (started)
             for (int k = 0; k < c; k++)
-                ge_add(&acc, &acc, &acc);
+                ge_dbl(&acc, &acc);
         int used = 0;
         for (Py_ssize_t i = 0; i < n; i++) {
             unsigned d = get_digit(scalars + i * 32, w, c);
@@ -630,6 +776,45 @@ static PyObject *py_msm(PyObject *self, PyObject *args)
     return PyBytes_FromStringAndSize((const char *)out, 32);
 }
 
+/* torsion_free(ext: n*160 bytes) -> ok: n bytes (1 = prime-order) */
+static PyObject *py_torsion_free(PyObject *self, PyObject *args)
+{
+    Py_buffer eb;
+    if (!PyArg_ParseTuple(args, "y*", &eb))
+        return NULL;
+    if (eb.len % GE_EXT_BYTES) {
+        PyBuffer_Release(&eb);
+        PyErr_SetString(PyExc_ValueError, "need n*160-byte points");
+        return NULL;
+    }
+    Py_ssize_t n = eb.len / GE_EXT_BYTES;
+    PyObject *ok_o = PyBytes_FromStringAndSize(NULL, n);
+    if (!ok_o) {
+        PyBuffer_Release(&eb);
+        return NULL;
+    }
+    uint8_t *ok = (uint8_t *)PyBytes_AS_STRING(ok_o);
+    const uint8_t *ext = (const uint8_t *)eb.buf;
+    int bad = 0;
+    Py_BEGIN_ALLOW_THREADS
+    for (long long i = 0; i < n; i++) {
+        ge g;
+        if (!ge_load(&g, ext + i * GE_EXT_BYTES)) {
+            bad = 1;
+            break;
+        }
+        ok[i] = ge_torsion_free(&g) ? 1 : 0;
+    }
+    Py_END_ALLOW_THREADS
+    PyBuffer_Release(&eb);
+    if (bad) {
+        Py_DECREF(ok_o);
+        PyErr_SetString(PyExc_ValueError, "malformed extended-point limbs");
+        return NULL;
+    }
+    return ok_o;
+}
+
 static PyMethodDef methods[] = {
     {"decompress", py_decompress, METH_VARARGS,
      "decompress(points32xN) -> (ok_flags, extended_limbs)"},
@@ -637,6 +822,8 @@ static PyMethodDef methods[] = {
      "msm_ext(extended_limbs, scalars32xN) -> compressed sum"},
     {"msm", py_msm, METH_VARARGS,
      "msm(points32xN, scalars32xN) -> compressed sum"},
+    {"torsion_free", py_torsion_free, METH_VARARGS,
+     "torsion_free(extended_limbs) -> ok_flags ([L]P == identity)"},
     {NULL, NULL, 0, NULL},
 };
 

@@ -160,6 +160,16 @@ def has_small_order(s: bytes) -> bool:
     return bytes(e) in small_order_blacklist()
 
 
+def is_torsion_free(pt) -> bool:
+    """Prime-order-subgroup membership: [L]·P == identity.  The strict
+    gate only rejects SMALL-order encodings; a mixed-torsion point
+    (prime-order part plus nonzero 8-torsion) passes it, and the
+    cofactorless aggregate MSM has only 1/8 soundness against such
+    points — the aggregate plane therefore requires this proof on every
+    point it trusts (native twin: halfagg.c ``torsion_free``)."""
+    return point_equal(scalar_mult(L, pt), IDENT)
+
+
 def _le_lt(x_words: "np.ndarray", bound: int) -> "np.ndarray":
     """(N, 4) uint64 little-endian words < bound, vectorized."""
     import numpy as np

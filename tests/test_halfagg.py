@@ -13,6 +13,7 @@ and knob-off against the reference per-envelope path.
 
 from __future__ import annotations
 
+import hashlib
 import random
 
 import pytest
@@ -76,6 +77,103 @@ def _off_curve_enc():
         if ref.decompress(enc) is None:
             return enc
     raise AssertionError("unreachable")
+
+
+_T8 = None
+
+
+def torsion8():
+    """A generator of the 8-torsion subgroup (order exactly 8) — the
+    mixed-torsion hostile lanes' raw material (same derivation as
+    ref.small_order_blacklist)."""
+    global _T8
+    if _T8 is None:
+        y = 2
+        while True:
+            pt = ref.decompress(int.to_bytes(y, 32, "little"))
+            y += 1
+            if pt is None:
+                continue
+            t = ref.scalar_mult(ref.L, pt)
+            if not ref.point_equal(ref.scalar_mult(4, t), ref.IDENT):
+                _T8 = t
+                break
+    return _T8
+
+
+def _torsioned_keypair(seed_i: int):
+    """An RFC 8032 keypair whose PUBLISHED pubkey is A = a·B + T with T
+    of order 8 — it passes the strict gate (canonical, not small-order)
+    but signing with the prime-order part yields signatures the
+    cofactorless reference verify rejects: s·B − h·A = R − h·T ≠ R.
+    Returns (A_enc, a, prefix, sign_fn)."""
+    seed = b"mixed-torsion %08d" % seed_i
+    h = hashlib.sha512(seed).digest()
+    a = int.from_bytes(h[:32], "little")
+    a &= (1 << 254) - 8
+    a |= 1 << 254
+    prefix = h[32:]
+    B = ref.base_point()
+    A = ref.compress(ref.point_add(ref.scalar_mult(a, B), torsion8()))
+
+    def sign(msg):
+        r = int.from_bytes(
+            hashlib.sha512(prefix + msg).digest(), "little"
+        ) % ref.L
+        R = ref.compress(ref.scalar_mult(r, B))
+        k = int.from_bytes(
+            hashlib.sha512(R + A + msg).digest(), "little"
+        ) % ref.L
+        s = (r + k * a) % ref.L
+        return R + s.to_bytes(32, "little")
+
+    return A, a, prefix, sign
+
+
+def _torsioned_a_item(seed_i=1, tag=b"mt"):
+    """A gate-passing, libsodium-INVALID item with a mixed-torsion A.
+    The message is chosen so the challenge h ≢ 0 (mod 8) — otherwise
+    h·T = identity and even libsodium would accept."""
+    A, _a, _pfx, sign = _torsioned_keypair(seed_i)
+    for i in range(64):
+        msg = b"%s ballot %06d" % (tag, i)
+        sig = sign(msg)
+        if not sodium.verify_detached(sig, msg, A):
+            return (A, msg, sig)
+    raise AssertionError("unreachable: h ≡ 0 mod 8 sixty-four times")
+
+
+def _torsioned_r_item(seed_i=1, tag=b"tr"):
+    """An attacker-crafted signature under an HONEST (prime-order) key
+    whose nonce point carries 8-torsion: R = r·B + T, with s computed
+    against the torsioned R's challenge.  libsodium's byte-compare
+    rejects it (s·B − h·A = r·B ≠ R), but the aggregate defect is the
+    pure-torsion −T — invisible to the cofactorless MSM whenever the
+    item's z ≡ 0 (mod 8).  (Simply mauling an existing signature's R
+    does NOT produce this: the stale s drags in a prime-order defect
+    the MSM catches at 2^-128.)"""
+    seed = b"torsioned-nonce %08d" % seed_i
+    hh = hashlib.sha512(seed).digest()
+    a = int.from_bytes(hh[:32], "little")
+    a &= (1 << 254) - 8
+    a |= 1 << 254
+    prefix = hh[32:]
+    B = ref.base_point()
+    A = ref.compress(ref.scalar_mult(a, B))
+    msg = b"%s crafted nonce %06d" % (tag, seed_i)
+    r = int.from_bytes(
+        hashlib.sha512(prefix + msg).digest(), "little"
+    ) % ref.L
+    r_enc = ref.compress(
+        ref.point_add(ref.scalar_mult(r, B), torsion8())
+    )
+    h = int.from_bytes(
+        hashlib.sha512(r_enc + A + msg).digest(), "little"
+    ) % ref.L
+    s = (r + h * a) % ref.L
+    sig = r_enc + s.to_bytes(32, "little")
+    assert not sodium.verify_detached(sig, msg, A)
+    return (A, msg, sig)
 
 
 # ---------------------------------------------------------------------------
@@ -228,6 +326,18 @@ def _lane_wrong_msg(items):
     return out
 
 
+def _lane_mixed_torsion_a(items):
+    out = list(items)
+    out[4] = _torsioned_a_item()
+    return out
+
+
+def _lane_torsioned_r(items):
+    out = list(items)
+    out[5] = _torsioned_r_item()
+    return out
+
+
 LANES = [
     _lane_honest,
     _lane_one_bad_sig,
@@ -239,6 +349,8 @@ LANES = [
     _lane_noncanonical_r,
     _lane_off_curve,
     _lane_wrong_msg,
+    _lane_mixed_torsion_a,
+    _lane_torsioned_r,
 ]
 
 
@@ -276,6 +388,131 @@ def test_batch_aggregated_matches_certificate():
     )
     bad = _lane_one_bad_sig(items)
     assert not verify_batch_aggregated(bad)
+
+
+# ---------------------------------------------------------------------------
+# mixed-torsion soundness: the exact class where cofactorless batch checks
+# diverge from libsodium's byte-compare verify (REVIEW r15)
+# ---------------------------------------------------------------------------
+
+
+def _libsodium_valid_torsioned_item(tag=b"lv"):
+    """A signature libsodium ACCEPTS under a mixed-torsion pubkey:
+    A = a·B + T, R = r·B + j·T with j ≡ −h (mod 8), s = r + h·a — the
+    defect s·B − h·A − R is exactly zero, so the byte-compare holds.
+    The aggregate plane must return True for it (verdict parity) while
+    never proving it through the MSM (its points are not prime-order)."""
+    A, a, _prefix, _sign = _torsioned_keypair(7)
+    B = ref.base_point()
+    T = torsion8()
+    msg = b"%s crafted statement" % tag
+    for r in range(1, 64):
+        r_base = ref.scalar_mult(r, B)
+        for j in range(8):
+            r_pt = ref.point_add(r_base, ref.scalar_mult(j, T))
+            r_enc = ref.compress(r_pt)
+            if ref.has_small_order(r_enc):
+                continue
+            h = int.from_bytes(
+                hashlib.sha512(r_enc + A + msg).digest(), "little"
+            ) % ref.L
+            if (h + j) % 8 == 0:
+                s = (r + h * a) % ref.L
+                sig = r_enc + s.to_bytes(32, "little")
+                assert sodium.verify_detached(sig, msg, A)
+                return (A, msg, sig)
+    raise AssertionError("unreachable: no (r, j) hit j ≡ -h (mod 8)")
+
+
+class TestMixedTorsionSoundness:
+    def test_parity_across_transcript_randomizations(self):
+        """Both hostile shapes (torsioned A honest-signed, honest A with
+        mauled R) stay bit-identical to libsodium across many transcript
+        randomizations.  Pre-fix, each randomization re-rolled the
+        Fiat-Shamir z_i — a 1/8 chance per flush of latching the invalid
+        envelope as valid; the prime-order gates make it deterministic."""
+        bad_a = _torsioned_a_item()
+        for it in range(16):
+            honest = make_items(5, start=2000 + 16 * it)
+            for bad in (bad_a, _torsioned_r_item(seed_i=it)):
+                items = honest + [bad]
+                assert not verify_batch_aggregated(
+                    items, point_cache=PointCache()
+                )
+                scheme, cache = fresh_scheme()
+                verdicts = scheme.verify_flush(items, [7] * 6)
+                assert verdicts == oracle(items)
+                assert verdicts[5] is False
+                pk, msg, sig = bad
+                key = cache.key_for(pk, sig, msg)
+                assert cache.peek_many([key]) == [None]
+
+    def test_torsioned_r_in_the_msm_blind_spot(self):
+        """THE reviewed attack, pinned at its most favorable transcript:
+        grind bucket compositions until the mauled item's z ≡ 0 (mod 8),
+        where the cofactorless MSM is blind to the pure-torsion defect
+        (pre-fix verify_batch_aggregated returned True here and the
+        scheme latched a libsodium-invalid envelope as valid)."""
+        found = None
+        idx = 3
+        hostile = _torsioned_r_item(seed_i=99)
+        for start in range(4000, 4960, 16):
+            items = make_items(8, start=start)
+            items[idx] = hostile
+            pks = [i[0] for i in items]
+            msgs = [i[1] for i in items]
+            rs = [i[2][:32] for i in items]
+            zs = H.coefficients(H.transcript_root(pks, msgs, rs), 8)
+            if zs[idx] % 8 == 0:
+                found = (items, idx)
+                break
+        assert found is not None, "no z ≡ 0 (mod 8) in 60 transcripts"
+        items, idx = found
+        pk, _msg, sig = items[idx]
+        assert ref.agg_input_ok(pk, sig)  # gate-passing, MSM-blind
+        assert not verify_batch_aggregated(items, point_cache=PointCache())
+        scheme, cache = fresh_scheme()
+        verdicts = scheme.verify_flush(items, [7] * 8)
+        assert verdicts == oracle(items)
+        assert verdicts[idx] is False
+
+    def test_libsodium_valid_torsioned_key_parity(self):
+        """Verdict parity in the OTHER direction: a crafted mixed-torsion
+        signature that libsodium accepts must come back True — through
+        the per-item fallback, never through an aggregate latch."""
+        crafted = _libsodium_valid_torsioned_item()
+        items = make_items(5, start=5000) + [crafted]
+        # not provable by the aggregate path (points are not prime-order)
+        assert not verify_batch_aggregated(items, point_cache=PointCache())
+        scheme, cache = fresh_scheme()
+        verdicts = scheme.verify_flush(items, [7] * 6)
+        assert verdicts == oracle(items) == [True] * 6
+        # the True verdict latched through the fallback's caching backend
+        pk, msg, sig = crafted
+        assert cache.peek_many([cache.key_for(pk, sig, msg)]) == [True]
+
+    def test_certificate_rejects_torsioned_points(self):
+        """The wire-certificate API has no fallback: its accept set is
+        explicitly narrowed to prime-order A and R (honest signers never
+        produce anything else), so the crafted libsodium-valid item —
+        whose defect is exactly zero, i.e. the MSM alone would PASS —
+        must still fail."""
+        crafted = _libsodium_valid_torsioned_item()
+        items = make_items(4, start=5100) + [crafted]
+        agg = aggregate(items)
+        pks = [i[0] for i in items]
+        msgs = [i[1] for i in items]
+        assert not verify_aggregated(pks, msgs, agg)
+
+    def test_aggregate_rejects_malformed_lengths(self):
+        items = make_items(2)
+        pk, msg, sig = items[0]
+        with pytest.raises(ValueError):
+            aggregate([(pk, msg, sig[:40])])
+        with pytest.raises(ValueError):
+            aggregate([(pk[:16], msg, sig)])
+        with pytest.raises(ValueError):
+            aggregate([items[1], (pk, msg, sig + b"\x00")])
 
 
 # ---------------------------------------------------------------------------
@@ -353,6 +590,32 @@ class TestNativeOracle:
                 )
                 assert got == ref.compress(pt)
 
+    def test_torsion_free_differential(self):
+        """Native [L]·P prime-order proof vs the ref25519 oracle: random
+        prime-order points (and the identity) pass; every one of their 7
+        nonzero-torsion translates fails."""
+        from stellar_tpu.native import load_halfagg
+
+        mod = load_halfagg()
+        rng = random.Random(41)
+        B = ref.base_point()
+        T = torsion8()
+        encs, expect = [ref.compress(ref.IDENT)], [True]
+        for k in (1, 2, 77, rng.randrange(1, ref.L)):
+            p = ref.scalar_mult(k, B)
+            encs.append(ref.compress(p))
+            expect.append(True)
+            for j in range(1, 8):
+                q = ref.point_add(p, ref.scalar_mult(j, T))
+                encs.append(ref.compress(q))
+                expect.append(False)
+        ok, ext = mod.decompress(b"".join(encs))
+        assert all(ok)
+        got = [bool(b) for b in mod.torsion_free(ext)]
+        assert got == expect
+        for enc, e in zip(encs, expect):
+            assert ref.is_torsion_free(ref.decompress(enc)) == e
+
     def test_python_fallback_agrees(self, monkeypatch):
         """The toolchain-less pure-Python path returns the same verdicts
         (it IS ref25519) — one honest and one poisoned batch."""
@@ -393,6 +656,17 @@ class TestPointCache:
         assert verify_batch_aggregated(items, point_cache=pc)
         assert len(pc) == 8
         assert verify_batch_aggregated(items, point_cache=pc)
+
+    def test_torsioned_key_negative_cached(self):
+        """A mixed-torsion pubkey decodes fine but is permanently
+        unusable for aggregation — it caches as None exactly like an
+        undecodable one, so the [L]·P ladder runs once per key, not once
+        per flush."""
+        pc = PointCache()
+        bad = _torsioned_a_item()
+        vals = H._decompress_many([bad[0]], pc)
+        assert vals == [None]
+        assert pc.get_many([bad[0]]) == [None]
 
 
 # ---------------------------------------------------------------------------
@@ -461,6 +735,28 @@ class TestSchemeDispatch:
         assert scheme.n_gate_rejects == 2
         assert scheme.n_agg_checks == 1 and scheme.n_agg_passed == 1
         assert be.calls == []
+
+    def test_unusable_key_prefilters_after_first_sight(self):
+        """A permanently-unusable pubkey (mixed-torsion) poisons its
+        bucket only on first sight: once negative-cached, its envelopes
+        route per-item BEFORE bucketing and the rest of the slot still
+        aggregates as one check."""
+        be = _RecordingBackend()
+        scheme = HalfAggScheme(be, VerifySigCache())
+        items = make_items(7, start=5200) + [_torsioned_a_item(tag=b"pf")]
+        v1 = scheme.verify_flush(items, [7] * 8)
+        assert v1 == oracle(items)
+        assert be.calls == [(8, CALLER_OVERLAY)]  # first sight: bucket falls back
+        assert scheme.n_agg_checks == 1 and scheme.n_agg_passed == 0
+        assert scheme.point_cache.get_many([items[7][0]]) == [None]
+        # second flush (the recording backend latches nothing, so every
+        # item is a verdict-cache miss again)
+        v2 = scheme.verify_flush(items, [7] * 8)
+        assert v2 == v1
+        assert be.calls[1] == (1, CALLER_OVERLAY)  # only the unusable key
+        assert scheme.n_agg_checks == 2 and scheme.n_agg_passed == 1
+        assert scheme.n_unaggregatable == 1
+        assert scheme.stats()["unaggregatable_envelopes"] == 1
 
     def test_knob_off_is_reference_path(self):
         """SCP_SIG_SCHEME="ed25519" restores the per-envelope path
@@ -578,6 +874,20 @@ class TestNodeWiring:
             assert 5 in h.scp_slot_buckets
             assert prev_max not in h.scp_slot_buckets
             assert len(h.scp_slot_buckets) <= cap
+            # the evict decision is heap-backed (no max() scan per
+            # envelope on the flood path) and the lazy heap stays bounded
+            assert h._slot_bucket_max() == max(h.scp_slot_buckets)
+            assert len(h._slot_bucket_heap) <= 4 * cap + 1
+            # BELOW-cap steady state (a healthy node, one bucket created
+            # and trimmed per closed slot): stale heap entries must not
+            # leak — the rebuild bound is relative to LIVE size, not cap
+            h.scp_slot_buckets.clear()
+            h._slot_bucket_heap.clear()
+            for slot in range(2 * 10**9, 2 * 10**9 + 1000):
+                h.recv_scp_envelope(envelope(slot))
+                for s in [s for s in h.scp_slot_buckets if s <= slot]:
+                    del h.scp_slot_buckets[s]  # the slot_closed trim shape
+            assert len(h._slot_bucket_heap) <= 4 * 16 + 1
         finally:
             clock.shutdown()
 

@@ -118,28 +118,43 @@ def test_byzantine_flood_halfagg_small():
 
 
 def test_flood_scheme_wall_ab():
-    """The liveness-floor differential, measured as crank wall: the SAME
-    mixed flood (storm + invalid) run under both schemes.  The aggregate
-    scheme must pay well under the per-signature scheme's envelope-verify
-    wall — the wall that wedges a flooded 1-core crank, so the envelope
-    rate that saturates the per-signature path leaves the aggregate path
-    with headroom (measured ~0.5-0.6x on this host; asserted <= 0.85 for
-    noise margin).  Both legs must hold the same liveness floor."""
+    """Scheme wall A/B under the SAME mixed flood (storm + invalid),
+    measured as crank verify wall — now a cost-REGRESSION gate, not a
+    win claim.  History: the pre-review scheme measured 0.5-0.6x here,
+    but that margin was subsidized by the mixed-torsion soundness hole
+    (REVIEW r15): a sound cofactorless-parity aggregate must prove every
+    fresh R prime-order ([L]·P, ~one scalar-mult per envelope — the same
+    class of cost libsodium's verify pays), which consumes the MSM's
+    savings on a scalar-CPU host.  Measured post-fix: the aggregate wall
+    is STABLE (~290 ms/run) while the per-signature wall swings with
+    this container's scheduler (±30%, the documented host-noise band),
+    so the ratio reads 1.0-1.45x across windows.  Per the repo's
+    measurement convention the deterministic oracles (parity, liveness
+    floor, cache cleanliness — the other tests in this file) carry the
+    evidence; this best-of-2 gate only catches a catastrophic cost
+    regression (<= 1.6x, e.g. re-proving cached validator keys every
+    flush).  The throughput win is conditional on offloading the
+    R-column proof to the TPU batch plane (ROADMAP lead — the verify
+    kernel already computes it as verify(A:=R, h:=L, s:=0,
+    R:=identity))."""
     from stellar_tpu.scenarios.scenario import Scenario
 
     walls = {}
     for scheme in ("ed25519-halfagg", "ed25519"):
-        spec = small_specs()["byzantine_flood_halfagg"]
-        spec.scp_sig_scheme = scheme
-        if scheme == "ed25519":
-            spec.name += "_persig_ab"
-        verify_cache().clear()
-        r = Scenario(spec).run()
-        assert r.ok, (scheme, r.failures)
-        walls[scheme] = r.scoreboard.aggregate["verify_wall_ms"]
-        assert r.scoreboard.aggregate["flush_envelopes"] > 3000
+        best = float("inf")
+        for rep in range(2):
+            spec = small_specs()["byzantine_flood_halfagg"]
+            spec.scp_sig_scheme = scheme
+            suffix = "_persig" if scheme == "ed25519" else ""
+            spec.name += "%s_ab%d" % (suffix, rep)
+            verify_cache().clear()
+            r = Scenario(spec).run()
+            assert r.ok, (scheme, r.failures)
+            best = min(best, r.scoreboard.aggregate["verify_wall_ms"])
+            assert r.scoreboard.aggregate["flush_envelopes"] > 3000
+        walls[scheme] = best
     ratio = walls["ed25519-halfagg"] / walls["ed25519"]
-    assert ratio <= 0.85, (
+    assert ratio <= 1.6, (
         "aggregate scheme paid %.2fx the per-signature verify wall"
         " at the same flood rate: %s" % (ratio, walls)
     )
