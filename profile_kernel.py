@@ -99,6 +99,127 @@ def main(batch=32768, ab=False):
         leg(None)
 
 
+def device_hash_ab(
+    batch: int, reps: int, out_path: str, expect_tpu: bool
+) -> int:
+    """Same-window paired device-hash certification (ISSUE r16): the
+    three numbers ROADMAP #2's acceptance compares —
+
+      rate_kernel_only       device-resident kernel calls (inputs staged
+                             and uploaded once; dispatch RTT netted out)
+      rate_e2e_host_hash     BatchVerifier.verify, host SHA-512 C stage
+      rate_e2e_device_hash   BatchVerifier.verify, SHA-512 fused on
+                             device (Config.DEVICE_HASH path)
+
+    Both end-to-end legs first prove the mixed hostile-lane mask
+    bit-exact vs libsodium on their exact compiled bucket.  Commits
+    DEVICE_HASH_r16.json; exits 1 when the certification leg (a real
+    accelerator, --tpu) misses the floor rate_e2e_device_hash >= 0.9 *
+    rate_kernel_only — the CPU leg is the always-runnable differential
+    oracle and records the same JSON without gating (its "device" IS the
+    host, so the fused sha competes with the C stage core-for-core)."""
+    import jax
+    import jax.numpy as jnp
+
+    sys.path.insert(0, REPO)
+    import __graft_entry__ as graft
+    from stellar_tpu.crypto import SecretKey
+    from stellar_tpu.ops.ed25519 import BatchVerifier
+
+    if expect_tpu:
+        assert jax.default_backend() == "tpu", (
+            f"--tpu leg ran on {jax.default_backend()!r}; a silent CPU "
+            "fallback must not be recorded as a chip measurement"
+        )
+    bv_host = BatchVerifier(max_batch=batch, streams=1, device_hash=False)
+    bv_dev = BatchVerifier(max_batch=batch, streams=1, device_hash=True)
+    batch = bv_host.max_batch  # granule rounding
+
+    # oracle first: the mixed valid/corrupt-R/corrupt-s/bad-A mask must
+    # be bit-exact on BOTH compiled buckets before anything is timed
+    t0 = time.perf_counter()
+    mixed, want = graft._mixed_lane_items(batch)
+    for bv, tag in ((bv_host, "host-hash"), (bv_dev, "device-hash")):
+        got = np.asarray(bv.verify(mixed))
+        assert (got == want).all(), (
+            f"{tag} verdicts diverge from libsodium at lanes "
+            f"{np.nonzero(got != want)[0][:8].tolist()}"
+        )
+    compile_s = time.perf_counter() - t0
+
+    items = []
+    for i in range(batch):
+        sk = SecretKey.pseudo_random_for_testing(900_000 + i)
+        msg = b"device hash ab %08d" % i
+        items.append((sk.public_raw, msg, sk.sign(msg)))
+
+    # kernel-only: one staged upload, then repeated device-resident calls
+    staged = bv_host._stage_chunk(items, 0, len(items))
+    arr = jnp.asarray(staged.packed)
+    bv_host._kernel(arr).block_until_ready()
+    trivial = jax.jit(lambda x: x[0] + 1)
+    trivial(arr).block_until_ready()
+    rtts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        trivial(arr).block_until_ready()
+        rtts.append(time.perf_counter() - t0)
+    rtt = min(rtts)
+    kt = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        ok = bv_host._kernel(arr)
+        ok.block_until_ready()
+        kt.append(time.perf_counter() - t0)
+    assert bool(np.asarray(ok)[: len(items)].all())
+    bv_host._pool.release(staged.bufs)
+    kernel_only = batch / max(1e-9, min(kt) - rtt)
+
+    def e2e(bv):
+        best = 0.0
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out = bv.verify(items)
+            dt = time.perf_counter() - t0
+            assert all(out)
+            best = max(best, len(items) / dt)
+        return best
+
+    e2e_host = e2e(bv_host)
+    e2e_dev = e2e(bv_dev)
+    floor = 0.9
+    ok_gate = e2e_dev >= floor * kernel_only
+    result = {
+        "round": "r16",
+        "harness": "profile_kernel.py --device-hash-ab"
+        + (" --tpu" if expect_tpu else ""),
+        "jax_backend": jax.default_backend(),
+        "kernel_backend": bv_host.backend,
+        "batch": batch,
+        "reps": reps,
+        "mixed_oracle_exact_both_layouts": True,
+        "compile_plus_oracle_s": round(compile_s, 1),
+        "dispatch_rtt_ms": round(rtt * 1e3, 2),
+        "rate_kernel_only": round(kernel_only, 1),
+        "rate_e2e_host_hash": round(e2e_host, 1),
+        "rate_e2e_device_hash": round(e2e_dev, 1),
+        "e2e_device_hash_vs_kernel_only": round(e2e_dev / kernel_only, 3),
+        "device_hash_vs_host_hash": round(e2e_dev / max(1e-9, e2e_host), 3),
+        "floor": floor,
+        "ok": ok_gate,
+        "gated": expect_tpu,
+        "generated_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=1)
+        f.write("\n")
+    print(json.dumps(result), flush=True)
+    # only the accelerator leg certifies/gates; the CPU oracle leg is
+    # informational (on a CPU host the "device" sha shares the silicon
+    # the C host stage would have used)
+    return 0 if (ok_gate or not expect_tpu) else 1
+
+
 def mesh_leg(n_devices: int, per_chip: int, reps: int, expect_tpu: bool) -> int:
     """One curve point, run in a child whose platform/device count the
     parent pinned.  Proves the mixed-lane oracle mask (incl. a remainder
@@ -354,6 +475,28 @@ def _flag_val(argv, name, default):
 
 if __name__ == "__main__":
     argv = sys.argv[1:]
+    if "--device-hash-ab" in argv:
+        tpu = "--tpu" in argv
+        if not tpu:
+            # the CPU oracle leg must not touch the relay backend
+            os.environ.setdefault("JAX_PLATFORMS", "cpu")
+            try:
+                import jax
+
+                jax.config.update("jax_platforms", "cpu")
+            except Exception:
+                pass
+        out = _flag_val(argv, "--out", None) or os.path.join(
+            REPO, "DEVICE_HASH_TPU_r16.json" if tpu else "DEVICE_HASH_r16.json"
+        )
+        sys.exit(
+            device_hash_ab(
+                int(_flag_val(argv, "--batch", "8192")),
+                int(_flag_val(argv, "--reps", "3")),
+                out,
+                expect_tpu=tpu,
+            )
+        )
     if "--mesh-leg" in argv:
         sys.exit(
             mesh_leg(

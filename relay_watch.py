@@ -147,6 +147,18 @@ FINAL_STEPS = [
       "print(json.dumps(r)); "
       "assert r['speedup_vs_per_envelope'] >= 0.80, r"],
      900),
+    # r16: device-resident hash certification — same-window paired
+    # kernel-only / e2e-host-hash / e2e-device-hash rates through the
+    # SHIPPED BatchVerifier (mixed hostile-lane oracle proven on both
+    # compiled layouts first), committing DEVICE_HASH_TPU_r16.json.
+    # Exits 1 when e2e device-hash < 0.9x kernel-only on the same
+    # window (ROADMAP #2 acceptance); the relay-independent CPU oracle
+    # leg is committed as DEVICE_HASH_r16.json by profile_kernel
+    # --device-hash-ab without --tpu.
+    ("device_hash_r16",
+     [sys.executable, "-u", "profile_kernel.py", "--device-hash-ab",
+      "--tpu"],
+     1800),
 ]
 ALL_NAMES = (
     [s[0] for s in SCRIPT_STEPS]

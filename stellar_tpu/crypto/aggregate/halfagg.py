@@ -231,6 +231,31 @@ def _decompress_many(
     return vals
 
 
+def torsion_free_encs(encs: Sequence[bytes]) -> List[bool]:
+    """Host prime-order proofs straight from compressed encodings: True
+    iff the encoding is canonical, strict-decodable AND torsion-free.
+    This is the SigBackend.torsion_check host path (and the oracle the
+    device batch-plane prover is differential-tested against)."""
+    out = [False] * len(encs)
+    well = [i for i, e in enumerate(encs) if len(e) == 32]
+    if not well:
+        return out
+    vals = _decompress_many([encs[i] for i in well], None, check_torsion=False)
+    idx = [k for k, v in enumerate(vals) if v is not None]
+    free = _torsion_free_many([vals[k] for k in idx])
+    for k, tf in zip(idx, free):
+        out[well[k]] = tf
+    return out
+
+
+def torsion_free_points(vals: Sequence) -> List[bool]:
+    """Prime-order proofs over ALREADY-DECODED points (the non-None
+    values ``_decompress_many`` returns) — the re-decode-free host path
+    for callers that hold both the encodings and the decoded points
+    (SigBackend.torsion_check's ``vals`` fast path)."""
+    return _torsion_free_many(vals)
+
+
 def _torsion_free_many(vals: Sequence) -> List[bool]:
     """Prime-order-subgroup proof per decoded point ([L]·P == identity).
     ``vals`` are non-None values from ``_decompress_many`` — native ext
@@ -264,6 +289,7 @@ def verify_aggregated(
     msgs: Sequence[bytes],
     aggsig: bytes,
     point_cache: Optional[PointCache] = None,
+    torsion_prover=None,
 ) -> bool:
     """Verify a half-aggregate certificate against its statement list.
     True ⇒ every (A_i, m_i) carries a signature libsodium would accept
@@ -308,7 +334,14 @@ def verify_aggregated(
         return False
     # the MSM is blind to torsion whenever the z_i conspire mod 8; only
     # a prime-order proof of the fresh R column closes the 1/8 hole (the
-    # A column was proven inside _decompress_many, cached)
+    # A column was proven inside _decompress_many, cached).  A
+    # torsion_prover (the device batch plane, SigBackend.torsion_check)
+    # serves the proofs from the R ENCODINGS — already proven canonical
+    # and decodable above, so prover and host ladder agree bit-exactly;
+    # the decoded r_pts ride along so a host-riding prover (cutover,
+    # wedge latch) never re-decodes what this pass already decoded.
+    if torsion_prover is not None:
+        return all(torsion_prover(rs, r_pts))
     return all(_torsion_free_many(r_pts))
 
 
@@ -320,13 +353,18 @@ def verify_batch_aggregated(
     items: Sequence[VerifyTriple],
     point_cache: Optional[PointCache] = None,
     gated: bool = False,
+    torsion_prover=None,
 ) -> bool:
     """Aggregate-then-verify a batch of full signatures in one check —
     the node-local form the SCP scheme uses (the node holds every s_i; a
     wire-format certificate would drop them).  Semantically identical to
     ``verify_aggregated(aggregate(items))`` minus one transcript pass.
     ``gated=True`` skips the per-item strict gate (the caller already
-    ran ``agg_input_ok_batch`` and excluded the rejects)."""
+    ran ``agg_input_ok_batch`` and excluded the rejects).
+    ``torsion_prover`` ((encs, decoded_pts) -> [bool]) serves the
+    post-MSM fresh-R prime-order proofs — the scheme passes the
+    backend's device batch plane here (ROADMAP #3 remainder (a));
+    None = the host ladder."""
     n = len(items)
     if n == 0:
         return True
@@ -357,5 +395,10 @@ def verify_batch_aggregated(
         return False
     # cofactorless-MSM pass alone is 1/8-sound against a mauled R = R₀+T;
     # only latch-grade once every fresh R is proven prime-order (A column
-    # proven via the cache in _decompress_many; B is prime-order)
+    # proven via the cache in _decompress_many; B is prime-order).  The
+    # prover sees the R ENCODINGS (canonical + decodable by this point),
+    # where device and host ladders agree bit-exactly, plus the decoded
+    # r_pts so a host-riding prover skips the second decompress pass.
+    if torsion_prover is not None:
+        return all(torsion_prover(rs, r_pts))
     return all(_torsion_free_many(r_pts))

@@ -134,6 +134,29 @@ class SigBackend:
     ) -> List[bool]:
         raise NotImplementedError
 
+    def torsion_check(
+        self,
+        encs: Sequence[bytes],
+        caller: str = CALLER_OVERLAY,
+        vals: Optional[Sequence] = None,
+    ) -> List[bool]:
+        """Batched prime-order-subgroup proofs ([L]·P == identity) over
+        compressed point encodings — the aggregate plane's fresh-R proof
+        surface (ROADMAP #3 remainder (a)).  True iff the encoding is a
+        canonical, decodable, torsion-free point.  The base
+        implementation strict-decodes + proves on host
+        (native/halfagg.c's ladder or the ref25519 oracle); the tpu
+        backend overrides with the device batch plane, same
+        cutover/wedge-latch contracts as verify_batch.  ``vals`` —
+        optional decoded points parallel to ``encs`` (what the aggregate
+        plane's _decompress_many already produced): the host path proves
+        them directly instead of re-decoding the encodings."""
+        from ..crypto.aggregate import halfagg
+
+        if vals is not None:
+            return halfagg.torsion_free_points(vals)
+        return halfagg.torsion_free_encs(encs)
+
     def verify_batch_async(
         self, items: Sequence[VerifyTriple], caller: str = CALLER_PIPELINE
     ) -> SigFlushFuture:
@@ -248,6 +271,17 @@ class CachingSigBackend(SigBackend):
 
         threading.Thread(target=work, name="sig-flush", daemon=True).start()
         return fut
+
+    def torsion_check(
+        self,
+        encs: Sequence[bytes],
+        caller: str = CALLER_OVERLAY,
+        vals: Optional[Sequence] = None,
+    ) -> List[bool]:
+        # no verdict caching here: point-level memoization lives in the
+        # aggregate plane's PointCache (keyed by encoding, where the
+        # proof is intrinsic), not the signature verify cache
+        return self.inner.torsion_check(encs, caller=caller, vals=vals)
 
     def stats(self) -> dict:
         return self.inner.stats()
@@ -369,6 +403,7 @@ class TpuSigBackend(SigBackend):
         cpu_cutover: int = DEFAULT_TPU_CPU_CUTOVER,
         streams: Optional[int] = None,
         native_hash: Optional[bool] = None,
+        device_hash: Optional[bool] = None,
         tracer=None,
     ):
         from ..ops.ed25519 import BatchVerifier  # lazy: JAX import
@@ -386,12 +421,17 @@ class TpuSigBackend(SigBackend):
             mesh = mesh_from_spec(sig_mesh)
         # native_hash: the C host stage (gate + batch SHA-512 mod L,
         # native/sighash.c) — default auto (on when it builds); stats()
-        # reports which stage is live as "native_host_stage"
+        # reports which stage is live as "native_host_stage".
+        # device_hash: the Config.DEVICE_HASH production wiring — the
+        # SHA-512 stage runs ON DEVICE fused ahead of the verify kernel
+        # (ops/sha512.py) and the host keeps only the strict gate; None
+        # defers to the STELLAR_TPU_DEVICE_HASH env default (off).
         self._verifier = BatchVerifier(
             max_batch=max_batch,
             mesh=mesh,
             streams=streams,
             native_hash=native_hash,
+            device_hash=device_hash,
             tracer=tracer,
         )
         # Below this many cache misses a device round-trip costs more than
@@ -400,7 +440,15 @@ class TpuSigBackend(SigBackend):
         # (see DEFAULT_TPU_CPU_CUTOVER for the breakeven arithmetic).
         self.cpu_cutover = cpu_cutover
         self.n_cutover_items = 0
+        self.n_cutover_torsion = 0
         self.n_wedge_fallback_items = 0
+        # per-surface first-dispatch latches: verify and torsion compile
+        # DIFFERENT executables (different bucket/branch), so each
+        # surface keeps the long compile budget until ITS OWN first
+        # device call has completed — a completed torsion dispatch must
+        # not shrink the first verify dispatch's budget, or vice versa
+        self._verify_warm = False
+        self._torsion_warm = False
         # Host-fallback latch, scoped PER CALLER CLASS (ISSUE r10): a
         # stalled pipelined prewarm (caller="pipeline") latches only the
         # pipeline plane — the synchronous close-path batches
@@ -449,11 +497,13 @@ class TpuSigBackend(SigBackend):
         # the slowest batch's host-verify latency
         with self._wedge_lock:
             wedged = time.monotonic() < self._wedged_until.get(caller, 0.0)
-            # every caller keeps the long budget until the first device call
-            # has COMPLETED (not merely been dispatched): a second caller
-            # arriving mid-compile rides the same XLA compile and must not
-            # false-latch a healthy device with the short budget
-            first = self._verifier.n_device_calls == 0
+            # every caller keeps the long budget until the first VERIFY
+            # device call has COMPLETED (not merely been dispatched): a
+            # second caller arriving mid-compile rides the same XLA
+            # compile and must not false-latch a healthy device with the
+            # short budget.  Torsion dispatches do not count — they
+            # compile a different executable (_torsion_warm below)
+            first = not self._verify_warm
         if wedged:
             self.n_wedge_fallback_items += len(items)
             with self._tracer.span(
@@ -467,9 +517,19 @@ class TpuSigBackend(SigBackend):
         err: List[BaseException] = []
         done = threading.Event()
 
+        calls_before = self._verifier.n_device_calls
+
         def work():
             try:
                 result[0] = self._verifier.verify(items)
+                # warm on COMPLETION of a REAL device dispatch, even when
+                # the caller's wait already timed out (orphaned worker):
+                # the executable is compiled now, so later retries must
+                # drop to the short budget.  An all-gate-rejected batch
+                # never dispatches (n_device_calls unchanged) and must
+                # NOT consume the first-dispatch compile budget
+                if self._verifier.n_device_calls > calls_before:
+                    self._verify_warm = True
             except BaseException as e:
                 err.append(e)
             finally:
@@ -511,9 +571,103 @@ class TpuSigBackend(SigBackend):
             raise err[0]
         return result[0]
 
+    def torsion_check(
+        self,
+        encs: Sequence[bytes],
+        caller: str = CALLER_OVERLAY,
+        vals: Optional[Sequence] = None,
+    ) -> List[bool]:
+        """Prime-order proofs on the device batch plane: the verify
+        kernel computes [L]·P == identity AS-IS via verify(A := P,
+        h := L, s := 0, R := identity-encoding) — no hash stage at all
+        (BatchVerifier.verify_torsion).  Same cutover arithmetic and
+        per-caller wedge latch as verify_batch: small batches (and a
+        wedged/stalled device) ride the host ladder — with the caller's
+        already-decoded ``vals`` when provided, so no second decompress
+        pass — and the aggregate plane can never hang on a dead
+        transport."""
+        if len(encs) < self.cpu_cutover:
+            self.n_cutover_torsion += len(encs)
+            with self._tracer.span(
+                "sig.host_torsion", items=len(encs), reason="cutover"
+            ):
+                return SigBackend.torsion_check(
+                    self, encs, caller=caller, vals=vals
+                )
+        with self._wedge_lock:
+            wedged = time.monotonic() < self._wedged_until.get(caller, 0.0)
+            # the torsion chunk compiles its OWN executable (different
+            # bucket/branch than verify), so the first TORSION dispatch
+            # gets the first-dispatch compile budget even when verify
+            # has already run — and symmetrically (see _verify_warm)
+            first = not self._torsion_warm
+        if wedged:
+            self.n_wedge_fallback_items += len(encs)
+            with self._tracer.span(
+                "sig.host_torsion",
+                items=len(encs),
+                reason="wedge-latch",
+                caller=caller,
+            ):
+                return SigBackend.torsion_check(
+                    self, encs, caller=caller, vals=vals
+                )
+        result: List[Any] = [None]
+        err: List[BaseException] = []
+        done = threading.Event()
+
+        calls_before = self._verifier.n_device_calls
+
+        def work():
+            try:
+                result[0] = self._verifier.verify_torsion(encs)
+                # warm only on a real completed dispatch — see
+                # _verify_warm (an all-undecodable batch never compiles)
+                if self._verifier.n_device_calls > calls_before:
+                    self._torsion_warm = True
+            except BaseException as e:
+                err.append(e)
+            finally:
+                done.set()
+
+        t = threading.Thread(target=work, name="tpu-torsion", daemon=True)
+        t.start()
+        timeout = self.DEVICE_FIRST_TIMEOUT if first else self.DEVICE_TIMEOUT
+        if not done.wait(timeout):
+            with self._wedge_lock:
+                self._wedged_until[caller] = (
+                    time.monotonic() + self.RETRY_INTERVAL
+                )
+                self.n_latch_flips[caller] = (
+                    self.n_latch_flips.get(caller, 0) + 1
+                )
+            self.n_wedge_fallback_items += len(encs)
+            _log.warning(
+                "device torsion batch stalled >%.0fs; finishing %d proofs"
+                " on host and latching the %r caller class onto host for"
+                " %.0fs",
+                timeout,
+                len(encs),
+                caller,
+                self.RETRY_INTERVAL,
+            )
+            with self._tracer.span(
+                "sig.host_torsion",
+                items=len(encs),
+                reason="device-stall",
+                caller=caller,
+            ):
+                return SigBackend.torsion_check(
+                    self, encs, caller=caller, vals=vals
+                )
+        if err:
+            raise err[0]
+        return result[0]
+
     def stats(self) -> dict:
         s = self._verifier.stats()
         s["cpu_cutover_items"] = self.n_cutover_items
+        s["cpu_cutover_torsion"] = self.n_cutover_torsion
         s["wedge_fallback_items"] = self.n_wedge_fallback_items
         s["wedge_latch_flips"] = dict(self.n_latch_flips)
         return s

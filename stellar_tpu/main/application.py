@@ -72,6 +72,7 @@ class Application:
             config.SIGNATURE_BACKEND,
             max_batch=config.SIG_BATCH_MAX,
             sig_mesh=config.SIG_MESH,
+            device_hash=bool(config.DEVICE_HASH),
             cpu_cutover=config.TPU_CPU_CUTOVER,
             streams=config.SIG_VERIFY_STREAMS,
             tracer=self.tracer,

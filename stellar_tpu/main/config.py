@@ -168,6 +168,16 @@ class Config:
         # one-chip "auto").  Only meaningful with SIGNATURE_BACKEND =
         # "tpu".
         self.SIG_MESH = 0
+        # device-resident verify hash stage (ops/sha512.py): the
+        # single-block SHA-512(R‖A‖M) mod L runs ON DEVICE fused ahead
+        # of the verify kernel, staging uploads raw bytes and the host
+        # keeps only the strict gate (multi-block >111-byte preimages
+        # ride the C host stage and merge at the kernel).  Off by
+        # default like SIG_MESH — a perf-plane opt-in certified by
+        # paired bench legs (rate_host_hash / rate_device_hash);
+        # verdicts are bit-exact either way (tests/test_sha512_device).
+        # Only meaningful with SIGNATURE_BACKEND = "tpu".
+        self.DEVICE_HASH = False
         # TPU-native addition: which signature scheme serves SCP envelope
         # verification for the quorum set this node faces
         # (crypto/aggregate/).  "ed25519" = the reference per-envelope
@@ -333,6 +343,14 @@ class Config:
             raise ValueError(
                 f'SIG_MESH must be 0, "auto", or a device count >= 1, '
                 f"got {sm!r}"
+            )
+        dh = self.DEVICE_HASH
+        if not (
+            isinstance(dh, bool)
+            or (isinstance(dh, int) and dh in (0, 1))
+        ):
+            raise ValueError(
+                f"DEVICE_HASH must be a boolean (or 0/1), got {dh!r}"
             )
         if not (
             isinstance(self.SIG_VERIFY_STREAMS, int)

@@ -394,9 +394,20 @@ gate_ok(const uint8_t *pk, const uint8_t *sig, const uint8_t *bl, int nbl)
 /* the batch job: gate + hash + transposed staging, tile-parallel      */
 /* ------------------------------------------------------------------ */
 
-#define TILE 64       /* items per transpose tile (8 KB scratch) */
+#define TILE 64       /* items per transpose tile (8/10 KB scratch) */
 #define PAR_MIN 2048  /* below this the fanout overhead isn't worth it */
 #define MAX_WORKERS 8
+
+/* device-hash staging layout (ops/sha512.py DH_ROWS): the device runs
+ * the SHA-512 stage, so single-block items upload RAW message bytes and
+ * the host keeps only the gate.  Multi-block (>111-byte preimage)
+ * residuals ride the existing C hash path right here and merge via the
+ * flag row. */
+#define DH_ROWS 160
+#define DH_ROW_M 96
+#define DH_ROW_MLEN 144
+#define DH_ROW_FLAG 145
+#define DH_MAX_MSG 47 /* 64 + mlen <= 111: single padded block */
 
 typedef struct {
     const uint8_t *pk; Py_ssize_t pk_len;
@@ -408,8 +419,10 @@ typedef struct {
 typedef struct {
     const Item *items;
     size_t n;
-    uint8_t *out;   /* (128, stride) row-major */
+    uint8_t *out;   /* (rowsz, stride) row-major */
     size_t stride;
+    size_t rowsz;   /* 128 (host-hash) or DH_ROWS (device-hash raw) */
+    int raw;        /* 1 = device-hash staging (gate only, raw M) */
     uint8_t *ok;    /* n bytes */
     const uint8_t *bl;
     int nbl;
@@ -440,31 +453,69 @@ item_row(const Item *it, uint8_t row[128], const uint8_t *bl, int nbl)
     return 1;
 }
 
+/* device-hash row (DH_ROWS wide): the host runs ONLY the strict gate.
+ * Single-block items (mlen <= 47, the dominant 96-byte R‖A‖M class)
+ * carry raw message bytes + mlen with flag = 1 — the device hashes;
+ * multi-block residuals keep the existing C hash path (flag = 0, h in
+ * rows 96:128) and merge at the same kernel. */
+static int
+item_row_raw(const Item *it, uint8_t row[DH_ROWS], const uint8_t *bl,
+             int nbl)
+{
+    uint8_t digest[64];
+    memset(row + 96, 0, DH_ROWS - 96);
+    if (it->pk_len != 32 || it->sig_len != 64) {
+        memset(row, 0, 96);
+        return 0;
+    }
+    memcpy(row, it->pk, 32);
+    memcpy(row + 32, it->sig, 32);
+    memcpy(row + 64, it->sig + 32, 32);
+    if (!gate_ok(it->pk, it->sig, bl, nbl)) {
+        /* fully inert lane: byte-identical with the Python staging twin
+         * (and no hostile bytes ride the upload) */
+        memset(row, 0, 96);
+        return 0;
+    }
+    if (it->msg_len <= DH_MAX_MSG) {
+        if (it->msg_len)
+            memcpy(row + DH_ROW_M, it->msg, (size_t)it->msg_len);
+        row[DH_ROW_MLEN] = (uint8_t)it->msg_len;
+        row[DH_ROW_FLAG] = 1;
+    } else {
+        sha512_rax(it->sig, it->pk, it->msg, (size_t)it->msg_len, digest);
+        reduce512_le(digest, row + 96);
+        /* mlen/flag stay 0: the device selects the uploaded h */
+    }
+    return 1;
+}
+
 static void
 run_job_tiles(void *arg)
 {
     Job *j = arg;
-    uint8_t rows[TILE][128];
+    uint8_t rows[TILE][DH_ROWS];
     size_t ntiles = (j->n + TILE - 1) / TILE;
-    size_t rej = 0, t;
+    size_t rej = 0, t, rowsz = j->rowsz;
     while ((t = __atomic_fetch_add(&j->next_tile, 1, __ATOMIC_RELAXED)) <
            ntiles) {
         size_t lo = t * TILE;
         size_t hi = lo + TILE;
-        size_t i, cnt;
-        int r;
+        size_t i, cnt, r;
         if (hi > j->n)
             hi = j->n;
         cnt = hi - lo;
         for (i = lo; i < hi; i++) {
-            int ok = item_row(&j->items[i], rows[i - lo], j->bl, j->nbl);
+            int ok = j->raw
+                ? item_row_raw(&j->items[i], rows[i - lo], j->bl, j->nbl)
+                : item_row(&j->items[i], rows[i - lo], j->bl, j->nbl);
             j->ok[i] = (uint8_t)ok;
             if (!ok)
                 rej++;
         }
         /* transpose the tile: rows[k][r] -> out[r][lo + k]; reads stay in
-         * the 8 KB scratch, writes are 64-byte contiguous runs per row */
-        for (r = 0; r < 128; r++) {
+         * the 10 KB scratch, writes are 64-byte contiguous runs per row */
+        for (r = 0; r < rowsz; r++) {
             uint8_t *dst = j->out + (size_t)r * j->stride + lo;
             for (i = 0; i < cnt; i++)
                 dst[i] = rows[i][r];
@@ -637,15 +688,16 @@ borrow_bytes(PyObject *o, const uint8_t **p, Py_ssize_t *len)
  *
  * items     sequence of (pk, msg, sig) tuples — the LAST three slots are
  *           used, so the verifier's (idx, pk, msg, sig) tuples work too
- * out       writable C-contiguous uint8 buffer of 128*stride bytes; the
- *           (128, stride) transposed staging layout (stride >= count);
- *           columns [count, stride) are zeroed (bucket padding)
+ * out       writable C-contiguous uint8 buffer of rowsz*stride bytes;
+ *           the (rowsz, stride) transposed staging layout (stride >=
+ *           count); columns [count, stride) are zeroed (bucket padding).
+ *           rowsz = 128 for stage(), DH_ROWS for stage_raw().
  * ok        writable uint8 buffer, >= count: per-item gate verdicts
  * blacklist k*32 bytes of sign-masked small-order encodings
  * threads   0 = auto (pool when count >= 2048 and >1 core), 1 = inline
  */
 static PyObject *
-sighash_stage(PyObject *self, PyObject *args)
+stage_common(PyObject *args, int raw)
 {
     PyObject *seq, *fast = NULL;
     Py_ssize_t start, count, stride;
@@ -653,18 +705,19 @@ sighash_stage(PyObject *self, PyObject *args)
     int threads = 0;
     Item *items = NULL;
     size_t rejects = 0;
+    size_t rowsz = raw ? DH_ROWS : 128;
     Py_ssize_t j;
-    int r;
-    (void)self;
+    size_t r;
 
     if (!PyArg_ParseTuple(args, "Onnw*w*y*|i", &seq, &start, &count, &out,
                           &okb, &bl, &threads))
         return NULL;
-    if (out.len % 128 != 0) {
-        PyErr_SetString(PyExc_ValueError, "out must be 128*stride bytes");
+    if (out.len % (Py_ssize_t)rowsz != 0) {
+        PyErr_Format(PyExc_ValueError, "out must be %zu*stride bytes",
+                     rowsz);
         goto fail;
     }
-    stride = out.len / 128;
+    stride = out.len / (Py_ssize_t)rowsz;
     if (count < 0 || start < 0 || stride < count || okb.len < count) {
         PyErr_SetString(PyExc_ValueError,
                         "out/ok too small for count (or negative range)");
@@ -712,6 +765,8 @@ sighash_stage(PyObject *self, PyObject *args)
         job.n = (size_t)count;
         job.out = (uint8_t *)out.buf;
         job.stride = (size_t)stride;
+        job.rowsz = rowsz;
+        job.raw = raw;
         job.ok = (uint8_t *)okb.buf;
         job.bl = (const uint8_t *)bl.buf;
         job.nbl = (int)(bl.len / 32);
@@ -729,7 +784,7 @@ sighash_stage(PyObject *self, PyObject *args)
         }
         /* zero the bucket-padding columns so padded lanes are inert */
         if (stride > count)
-            for (r = 0; r < 128; r++)
+            for (r = 0; r < rowsz; r++)
                 memset(job.out + (size_t)r * job.stride + count, 0,
                        (size_t)(stride - count));
         Py_END_ALLOW_THREADS
@@ -764,6 +819,25 @@ fail:
     if (bl.obj)
         PyBuffer_Release(&bl);
     return NULL;
+}
+
+static PyObject *
+sighash_stage(PyObject *self, PyObject *args)
+{
+    (void)self;
+    return stage_common(args, 0);
+}
+
+/* stage_raw(items, start, count, out, ok, blacklist, threads=0) ->
+ * rejects — the DEVICE-HASH staging pass: same strict gate, but the
+ * (DH_ROWS, stride) layout carries raw single-block message bytes for
+ * the device SHA-512 stage (ops/sha512.py); only multi-block residuals
+ * are hashed here.  Host cost per item drops to gate + memcpy. */
+static PyObject *
+sighash_stage_raw(PyObject *self, PyObject *args)
+{
+    (void)self;
+    return stage_common(args, 1);
 }
 
 /* sodium_verify(fn_addr, items, ok, threads=0) -> None
@@ -922,6 +996,10 @@ static PyMethodDef methods[] = {
     {"stage", sighash_stage, METH_VARARGS,
      "stage(items, start, count, out, ok, blacklist, threads=0) -> "
      "rejects: gate + SHA-512(R||A||M) mod L + transposed staging"},
+    {"stage_raw", sighash_stage_raw, METH_VARARGS,
+     "stage_raw(items, start, count, out, ok, blacklist, threads=0) -> "
+     "rejects: gate-only device-hash staging (raw single-block M bytes;"
+     " multi-block residuals hashed here, flag row 0)"},
     {"sodium_verify", sighash_sodium_verify, METH_VARARGS,
      "sodium_verify(fn_addr, items, ok, threads=0): batch libsodium"
      " strict verify over the worker pool, GIL released; verdicts land"

@@ -306,6 +306,23 @@ def _verify_packed(p, batch_inv: bool = False):
     )
 
 
+def _verify_packed_device_hash(p, batch_inv: bool = False):
+    """The DEVICE-HASH fusion: SHA-512(R‖A‖M) mod L computed on device
+    (ops/sha512.py) from the packed (160, N) raw-byte staging layout,
+    then the same verify kernel — one jit, no host hash.  flag=0 lanes
+    (multi-block residuals, torsion-proof columns) carry a host h in
+    rows 96:128 and bypass the device hash by selection."""
+    from . import sha512 as dsha
+
+    a = p[0:32].astype(jnp.int32)
+    r = p[32:64].astype(jnp.int32)
+    h = dsha.h_rows_from_packed(p)
+    return verify_kernel(
+        a, r, _nibbles_dev(p[64:96]), _nibbles_dev(h),
+        batch_inv=batch_inv,
+    )
+
+
 # sign-masked small-order encodings for the native gate (identical table
 # to the Python gate's — both derive from ref25519.small_order_blacklist)
 _BLACKLIST = b"".join(ref.small_order_blacklist())
@@ -326,7 +343,9 @@ class _Staged(NamedTuple):
 
 
 class _StagingPool:
-    """Reusable preallocated staging buffers, keyed by bucket size.
+    """Reusable preallocated staging buffers, keyed by (rows, bucket)
+    shape — 128 rows for the host-hash layout, sha512.DH_ROWS for the
+    device-hash raw layout.
 
     ``jnp.asarray`` may alias host memory on the CPU backend, so a buffer
     returns to the pool only AFTER its chunk's results have been drained
@@ -340,13 +359,14 @@ class _StagingPool:
         self._free = {}
         self._lock = threading.Lock()
 
-    def acquire(self, bucket: int):
+    def acquire(self, bucket: int, rows: int = 128):
+        key = (rows, bucket)
         with self._lock:
-            lst = self._free.get(bucket)
+            lst = self._free.get(key)
             if lst:
                 return lst.pop()
         return (
-            np.empty((128, bucket), dtype=np.uint8),
+            np.empty((rows, bucket), dtype=np.uint8),
             np.empty(bucket, dtype=np.uint8),
         )
 
@@ -359,7 +379,7 @@ class _StagingPool:
                 self.release(pair)
             return
         with self._lock:
-            self._free.setdefault(bufs[0].shape[1], []).append(bufs)
+            self._free.setdefault(bufs[0].shape, []).append(bufs)
 
 
 class BatchVerifier:
@@ -384,6 +404,7 @@ class BatchVerifier:
         streams: Optional[int] = None,
         host_assist: Optional[float] = None,
         native_hash: Optional[bool] = None,
+        device_hash: Optional[bool] = None,
         tracer=None,
     ):
         from ..trace import NULL_TRACER
@@ -392,6 +413,24 @@ class BatchVerifier:
         self.max_batch = max_batch
         self.min_device_batch = min_device_batch
         self.mesh = mesh
+        # Device-resident hash stage (ops/sha512.py; Config.DEVICE_HASH /
+        # STELLAR_TPU_DEVICE_HASH): the single-block SHA-512(R‖A‖M) mod L
+        # runs fused ahead of the verify kernel in the same jit, staging
+        # uploads RAW bytes (160 rows/item) and the host keeps only the
+        # strict gate; multi-block (>111-byte preimage) residuals ride
+        # the C hash path and merge via the flag row.  Off (default, like
+        # SIG_MESH) = the host-hash 128-row path, bit-exact either way.
+        if device_hash is None:
+            device_hash = (
+                os.environ.get("STELLAR_TPU_DEVICE_HASH", "0") == "1"
+            )
+        self.device_hash = bool(device_hash)
+        if self.device_hash:
+            from . import sha512 as _dsha
+
+            self._rows = _dsha.DH_ROWS
+        else:
+            self._rows = 128
         # Host stage: the native C extension (gate + batch SHA-512 mod L +
         # packed staging with the GIL released — native/sighash.c) when it
         # builds, else the hashlib/numpy fallback.  native_hash=False (or
@@ -405,6 +444,10 @@ class BatchVerifier:
             from .. import native as _native
 
             self._sighash = _native.load_sighash()
+        # a stale pre-r16 .so exposes stage() but not stage_raw(): the
+        # device-hash path then stages via the Python fallback (bit-exact,
+        # slower) instead of failing — tests pin this
+        self._has_stage_raw = hasattr(self._sighash, "stage_raw")
         # 0 = auto (the C stage fans out over its pool for large chunks)
         try:
             self._hash_threads = int(
@@ -464,6 +507,7 @@ class BatchVerifier:
         self.n_items = 0
         self.n_gate_rejects = 0
         self.n_host_assist_items = 0
+        self.n_torsion_items = 0
         self.verify_seconds = 0.0
         # n_device_calls is bumped from every stager thread; += alone
         # drops increments under streams>1 and the counter feeds
@@ -473,12 +517,18 @@ class BatchVerifier:
         self._calls_lock = threading.Lock()
 
     def _make_kernel(self):
-        """-> callable over the packed (128, N) uint8 staging array.
+        """-> callable over the packed (128, N) — or, with device_hash,
+        (160, N) — uint8 staging array.
 
         ONE host->device upload carries the whole chunk (A/R/s/h byte
-        rows); the row slicing, int32 widening and nibble splitting all
-        happen inside the jit program, so the device sees the same four
-        columns as before at 128 B/item of transfer."""
+        rows, or A/R/s/raw-M under device_hash); the row slicing, int32
+        widening, nibble splitting — and with device_hash the whole
+        SHA-512 mod L stage (ops/sha512.py) — all happen inside the jit
+        program, so the host never touches the hash path for the
+        dominant single-block class."""
+        packed_fn = (
+            _verify_packed_device_hash if self.device_hash else _verify_packed
+        )
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as PSpec
 
@@ -509,11 +559,27 @@ class BatchVerifier:
                 # the same kernel in interpreter mode
                 interpret = jax.default_backend() != "tpu"
 
-                def body(p):
-                    return verify_kernel_pallas(
-                        p[0:32], p[32:64], p[64:96], p[96:128],
-                        interpret=interpret,
-                    )
+                if self.device_hash:
+                    from .sha512 import sha512_pallas
+
+                    def body(p):
+                        # the sha stage grids the same per-shard batch
+                        # tiles, so both pallas_calls fuse into one jit
+                        # with no cross-shard communication
+                        h = sha512_pallas(p, interpret=interpret)
+                        return verify_kernel_pallas(
+                            p[0:32], p[32:64], p[64:96],
+                            h.astype(jnp.uint8),
+                            interpret=interpret,
+                        )
+
+                else:
+
+                    def body(p):
+                        return verify_kernel_pallas(
+                            p[0:32], p[32:64], p[64:96], p[96:128],
+                            interpret=interpret,
+                        )
 
                 fn = shard_map(
                     body,
@@ -527,7 +593,7 @@ class BatchVerifier:
                 )
                 return jax.jit(fn, in_shardings=(shard,), out_shardings=vec)
             return jax.jit(
-                partial(_verify_packed, batch_inv=False),
+                partial(packed_fn, batch_inv=False),
                 in_shardings=(shard,),
                 out_shardings=vec,
             )
@@ -536,15 +602,27 @@ class BatchVerifier:
 
             interpret = jax.default_backend() != "tpu"
 
-            def packed_pallas(p):
-                return verify_kernel_pallas(
-                    p[0:32], p[32:64], p[64:96], p[96:128],
-                    interpret=interpret,
-                )
+            if self.device_hash:
+                from .sha512 import sha512_pallas
+
+                def packed_pallas(p):
+                    h = sha512_pallas(p, interpret=interpret)
+                    return verify_kernel_pallas(
+                        p[0:32], p[32:64], p[64:96], h.astype(jnp.uint8),
+                        interpret=interpret,
+                    )
+
+            else:
+
+                def packed_pallas(p):
+                    return verify_kernel_pallas(
+                        p[0:32], p[32:64], p[64:96], p[96:128],
+                        interpret=interpret,
+                    )
 
             return jax.jit(packed_pallas)
         # unsharded batch axis: the lane-tree batched inversion is safe
-        return jax.jit(partial(_verify_packed, batch_inv=True))
+        return jax.jit(partial(packed_fn, batch_inv=True))
 
     def _bucket(self, n: int) -> int:
         # _granule already folds the mesh width in (n_shards, or NT tiles
@@ -652,10 +730,121 @@ class BatchVerifier:
         self.verify_seconds += time.perf_counter() - t0
         return out
 
-    def _run_pipeline(self, items, chunks, pending, drain_one):
+    def verify_torsion(self, encs: Sequence[bytes]) -> List[bool]:
+        """Batched prime-order-subgroup proofs on the SAME compiled
+        verify kernel: [L]·P == identity is computed AS-IS via
+        verify(A := P, h := L, s := 0, R := identity-encoding) — the
+        ladder evaluates 0·B + L·(−P) and the byte compare against the
+        identity encoding passes iff L·P is the identity (−identity ==
+        identity).  No hash stage runs at all: the h column carries L
+        directly, and under the device-hash layout the all-flag-0
+        torsion chunk takes the sha stage's chunk-level lax.cond
+        passthrough — the 80 rounds are skipped, not computed-and-
+        discarded.
+
+        This is the aggregate plane's fresh-R proof offload (ROADMAP #3
+        remainder (a)): ~31 µs/point of host ``torsion_free`` becomes a
+        device batch lane at ~the marginal verify cost, through the same
+        mesh dispatch / staging-pool / drain machinery as verify().
+
+        Input contract: ``encs`` are compressed point encodings.  A
+        malformed length, non-canonical y, or undecodable encoding
+        returns False (matching the host path, which strict-decodes
+        first); callers on the aggregate plane only pass gated canonical
+        encodings."""
+        encs = encs if isinstance(encs, (list, tuple)) else list(encs)
+        out = [False] * len(encs)
+        if not encs:
+            return out
+        self.n_torsion_items += len(encs)
+        pending = []
+
+        def drain_one():
+            (start, n), staged, fut = pending.pop(0)
+            dsp = self._tracer.begin("ed25519.torsion_drain")
+            if fut is not None:
+                res = np.logical_and(
+                    np.asarray(fut)[:n], staged.ok[:n]
+                ).tolist()
+                out[start : start + n] = res
+            self._tracer.end(dsp, items=n)
+            if staged is not None:
+                self._pool.release(staged.bufs)
+
+        chunks = [
+            (s, min(self.max_batch, len(encs) - s))
+            for s in range(0, len(encs), self.max_batch)
+        ]
+        self._run_pipeline(
+            encs, chunks, pending, drain_one, stage_fn=self._stage_torsion
+        )
+        return out
+
+    def _stage_torsion(self, encs, start, n) -> Optional[_Staged]:
+        """Stage a torsion-proof chunk: A column = the encodings, R =
+        identity encoding, s = 0, h = L (host-precomputed — no hash).
+        Same pooled buffers / per-shard upload as the verify path."""
+        if n == 0:
+            return None
+        if self.mesh is not None:
+            n_shards = len(self.mesh.devices.flat)
+            bucket = self._bucket(n)
+            shard_bucket = bucket // n_shards
+            bufs = []
+            ok = np.empty(n, dtype=bool)
+            for k in range(n_shards):
+                pair = self._pool.acquire(shard_bucket, self._rows)
+                bufs.append(pair)
+                packed, okbuf = pair
+                lo = k * shard_bucket
+                cnt = min(shard_bucket, max(0, n - lo))
+                if cnt == 0:
+                    packed[:] = 0
+                    continue
+                self._fill_torsion(encs, start + lo, cnt, packed, okbuf)
+                ok[lo : lo + cnt] = okbuf[:cnt].astype(bool)
+            return _Staged([p for p, _ in bufs], ok, n, tuple(bufs))
+        bucket = self._bucket(n)
+        bufs = self._pool.acquire(bucket, self._rows)
+        packed, okbuf = bufs
+        self._fill_torsion(encs, start, n, packed, okbuf)
+        return _Staged(packed, okbuf[:n].astype(bool), n, bufs)
+
+    @staticmethod
+    def _fill_torsion(encs, start, n, packed, okbuf) -> None:
+        """numpy fill of one torsion chunk.  The device decompress does
+        not re-check y-canonicity (the verify path's host gate does), so
+        non-canonical encodings are gated right here to keep parity with
+        the strict host decode."""
+        from . import sha512 as dsha
+
+        packed[:, :] = 0
+        ok = np.zeros(n, dtype=bool)
+        well = [j for j in range(n) if len(encs[start + j]) == 32]
+        if well:
+            enc_arr = np.frombuffer(
+                b"".join(encs[start + j] for j in well), dtype=np.uint8
+            ).reshape(-1, 32)
+            # canonical y < 2^255 - 19 (sign bit masked) — the SAME
+            # vectorized compare ref.strict_input_ok_batch runs, so the
+            # torsion accept set has one implementation, not a twin
+            enc_m = enc_arr.copy()
+            enc_m[:, 31] &= 0x7F
+            canon = ref._le_lt(enc_m.view("<u8").reshape(-1, 4), ref.P)
+            idx = np.asarray(well, dtype=np.intp)
+            ok[idx] = canon
+            live = idx[canon]
+            packed[0:32, live] = enc_arr[canon].T
+        # R := identity encoding (0x01 ‖ 0^31), h := L, on live lanes only
+        packed[32, :n] = ok
+        packed[96:128, :n] = dsha.L_BYTES[:, None] * ok[None, :]
+        okbuf[:n] = ok
+
+    def _run_pipeline(self, items, chunks, pending, drain_one, stage_fn=None):
+        stage = stage_fn if stage_fn is not None else self._stage_chunk
         if len(chunks) <= 1:
             for rng in chunks:
-                staged = self._stage_chunk(items, *rng)
+                staged = stage(items, *rng)
                 pending.append((rng, staged, self._dispatch_staged(staged)))
             while pending:
                 drain_one()
@@ -675,7 +864,7 @@ class BatchVerifier:
             depth = max(PIPELINE_DEPTH, self.streams + 1)
 
             def stage_and_dispatch(rng):
-                staged = self._stage_chunk(items, *rng)
+                staged = stage(items, *rng)
                 return staged, self._dispatch_staged(staged)
 
             with ThreadPoolExecutor(max_workers=self.streams) as stager:
@@ -720,18 +909,16 @@ class BatchVerifier:
         if self.mesh is not None:
             return self._stage_chunk_sharded(items, start, n)
         bucket = self._bucket(n)
-        bufs = self._pool.acquire(bucket)
+        bufs = self._pool.acquire(bucket, self._rows)
         packed, okbuf = bufs
         sp = self._tracer.begin("ed25519.host_hash")
-        if self._sighash is not None:
-            rejects = self._sighash.stage(
-                items, start, n, packed, okbuf, _BLACKLIST,
-                self._hash_threads,
-            )
-        else:
-            rejects = self._stage_py(items, start, n, packed, okbuf)
+        rejects = self._stage_into(items, start, n, packed, okbuf)
         self._tracer.end(
-            sp, items=n, native=self._sighash is not None, rejects=rejects
+            sp,
+            items=n,
+            native=self._sighash is not None,
+            rejects=rejects,
+            device_hash=self.device_hash,
         )
         if rejects:
             with self._calls_lock:  # stager threads update concurrently
@@ -756,7 +943,7 @@ class BatchVerifier:
         rejects = 0
         sp = self._tracer.begin("ed25519.host_hash")
         for k in range(n_shards):
-            pair = self._pool.acquire(shard_bucket)
+            pair = self._pool.acquire(shard_bucket, self._rows)
             bufs.append(pair)
             packed, okbuf = pair
             lo = k * shard_bucket
@@ -764,13 +951,10 @@ class BatchVerifier:
             if cnt == 0:
                 packed[:] = 0  # dead shard: every lane is inert padding
                 continue
-            if self._sighash is not None:
-                rejects += self._sighash.stage(
-                    items, start + lo, cnt, packed, okbuf, _BLACKLIST,
-                    self._hash_threads,
-                )
-            else:
-                rejects += self._stage_py(items, start + lo, cnt, packed, okbuf)
+            # under device_hash the per-chip pass drops its SHA stage:
+            # gate + raw-byte packing only (the r16 lever — one full C
+            # hash pass PER CHIP was the mesh's host feed bottleneck)
+            rejects += self._stage_into(items, start + lo, cnt, packed, okbuf)
             ok[lo : lo + cnt] = okbuf[:cnt].astype(bool)
         self._tracer.end(
             sp,
@@ -778,11 +962,86 @@ class BatchVerifier:
             native=self._sighash is not None,
             rejects=rejects,
             shards=n_shards,
+            device_hash=self.device_hash,
         )
         if rejects:
             with self._calls_lock:  # stager threads update concurrently
                 self.n_gate_rejects += int(rejects)
         return _Staged([p for p, _ in bufs], ok, n, tuple(bufs))
+
+    def _stage_into(self, items, start, n, packed, okbuf) -> int:
+        """One host-stage pass into a pooled buffer: the C extension when
+        it built (GIL released for the whole pass), else the Python
+        fallback — routed by layout.  Host-hash: gate + SHA-512 mod L +
+        (128, ·) staging.  Device-hash: gate + raw-byte (160, ·) staging
+        (stage_raw; a stale pre-r16 .so without it rides the Python
+        fallback bit-exactly)."""
+        if self.device_hash:
+            if self._has_stage_raw:
+                return self._sighash.stage_raw(
+                    items, start, n, packed, okbuf, _BLACKLIST,
+                    self._hash_threads,
+                )
+            return self._stage_py_raw(items, start, n, packed, okbuf)
+        if self._sighash is not None:
+            return self._sighash.stage(
+                items, start, n, packed, okbuf, _BLACKLIST,
+                self._hash_threads,
+            )
+        return self._stage_py(items, start, n, packed, okbuf)
+
+    def _stage_py_raw(self, items, start, n, packed, okbuf) -> int:
+        """Pure-Python device-hash staging (numpy gate + raw-byte pack;
+        hashlib only for the multi-block residual class) filling the
+        (160, ·) layout — the no-toolchain / stale-.so fallback twin of
+        native stage_raw."""
+        from . import sha512 as dsha
+
+        chunk = [items[start + j] for j in range(n)]
+        ok = np.zeros(n, dtype=bool)
+        well = [
+            j
+            for j, it in enumerate(chunk)
+            if len(it[-3]) == 32 and len(it[-1]) == 64
+        ]
+        packed[:, :n] = 0
+        if well:
+            pk_arr = np.frombuffer(
+                b"".join(chunk[j][-3] for j in well), dtype=np.uint8
+            ).reshape(-1, 32)
+            sig_arr = np.frombuffer(
+                b"".join(chunk[j][-1] for j in well), dtype=np.uint8
+            ).reshape(-1, 64)
+            gate = ref.strict_input_ok_batch(pk_arr, sig_arr)
+            sha = hashlib.sha512
+            for k, j in enumerate(well):
+                if not gate[k]:
+                    continue
+                ok[j] = True
+                pk, msg, sig = chunk[j][-3], chunk[j][-2], chunk[j][-1]
+                packed[0:32, j] = pk_arr[k]
+                packed[32:64, j] = sig_arr[k, :32]
+                packed[64:96, j] = sig_arr[k, 32:]
+                if len(msg) <= dsha.MAX_DEVICE_MSG:
+                    if msg:
+                        packed[96 : 96 + len(msg), j] = np.frombuffer(
+                            msg, dtype=np.uint8
+                        )
+                    packed[dsha.ROW_MLEN, j] = len(msg)
+                    packed[dsha.ROW_FLAG, j] = 1
+                else:
+                    h = (
+                        int.from_bytes(
+                            sha(sig[:32] + pk + msg).digest(), "little"
+                        )
+                        % L
+                    )
+                    packed[96:128, j] = np.frombuffer(
+                        h.to_bytes(32, "little"), dtype=np.uint8
+                    )
+        packed[:, n:] = 0
+        okbuf[:n] = ok
+        return n - int(ok.sum())
 
     def _stage_py(self, items, start, n, packed, okbuf) -> int:
         """Pure-Python host stage (hashlib + the vectorized numpy gate)
@@ -860,7 +1119,7 @@ class BatchVerifier:
         ]
         bucket = sum(buf.shape[1] for buf in shards)
         return jax.make_array_from_single_device_arrays(
-            (128, bucket), self._shard_sharding, singles
+            (self._rows, bucket), self._shard_sharding, singles
         )
 
     def stats(self) -> dict:
@@ -874,6 +1133,12 @@ class BatchVerifier:
             "gate_rejects": self.n_gate_rejects,
             "host_assist_items": self.n_host_assist_items,
             "native_host_stage": self._sighash is not None,
+            # device-resident SHA-512 stage (ops/sha512.py): True = the
+            # host keeps only the strict gate for single-block preimages
+            "device_hash": self.device_hash,
+            # [L]·P == identity proofs served on the batch plane (the
+            # aggregate scheme's fresh-R offload)
+            "torsion_items": self.n_torsion_items,
             "verify_seconds": self.verify_seconds,
             # 0 = unsharded single-queue dispatch; >0 = chips on the
             # batch-axis mesh (Config.SIG_MESH; bench close lines carry

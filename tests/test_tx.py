@@ -738,6 +738,8 @@ def test_wedged_device_dispatch_falls_back_to_host_and_latches():
     be.cpu_cutover = 0
     be.n_cutover_items = 0
     be.n_wedge_fallback_items = 0
+    be._verify_warm = True  # past warm-up: the short DEVICE_TIMEOUT applies
+    be._torsion_warm = False
     be._wedged_until = {}
     be.n_latch_flips = {}
     be._wedge_lock = threading.Lock()
@@ -745,7 +747,7 @@ def test_wedged_device_dispatch_falls_back_to_host_and_latches():
 
     class WedgedVerifier:
         calls = 0
-        n_device_calls = 1  # past warm-up: the short DEVICE_TIMEOUT applies
+        n_device_calls = 1
 
         def verify(self, items):
             WedgedVerifier.calls += 1
