@@ -642,7 +642,89 @@ def bench_byzantine_flood(n=2048, reps=3, items=None):
             best_g = min(best_g, time.perf_counter() - t0)
         assert not okbuf.any(), "hostile-s flood must fail the strict gate"
         out["gate_stage_rejects_per_sec"] = round(n / best_g, 1)
+
+    # class 3: send-side survival plane (ISSUE r17) — a stalled peer's
+    # bounded priority queue under tx-flood fan-out: shed throughput and
+    # the queue-byte high-water vs its configured cap, with CRITICAL
+    # provably untouched
+    # n is independent of the fixture: the shed path needs enough frames
+    # to fill the in-flight window + the cap before the sheds start
+    out["sendq"] = bench_sendq_shed(reps=reps)
     return out
+
+
+def bench_sendq_shed(n=2048, reps=3, cap_bytes=64 * 1024):
+    """Send-queue shed microbench (overlay/sendqueue.py): flood-class
+    frames at a peer whose transport never drains — every push past the
+    cap is an O(1) shed-oldest.  Reports ``sendq_shed_per_sec`` (the rate
+    the node can absorb a flood it is discarding) and the queue-byte
+    high-water against the cap (the bounded-memory claim)."""
+    import types
+
+    from stellar_tpu.main.config import Config
+    from stellar_tpu.overlay.sendqueue import (
+        CLASS_CRITICAL,
+        CLASS_FLOOD,
+        SendQueue,
+        SendQueueStats,
+    )
+    from stellar_tpu.util import MetricsRegistry, VirtualClock
+    from stellar_tpu.xdr.overlay import MessageType, StellarMessage
+
+    cfg = Config()
+    cfg.OVERLAY_SENDQ_BYTES = cap_bytes
+    cfg.OVERLAY_SENDQ_FLOOD_MSGS = 256
+    clock = VirtualClock()
+    app = types.SimpleNamespace(
+        config=cfg,
+        clock=clock,
+        metrics=MetricsRegistry(clock),
+        overlay_manager=types.SimpleNamespace(
+            sendq_stats=SendQueueStats(), load_manager=None
+        ),
+        tracer=None,
+    )
+    peer = types.SimpleNamespace(
+        app=app,
+        FRAME_WIRE_OVERHEAD=0,
+        send_mac_seq=0,
+        send_mac_key=b"\x07" * 32,
+        peer_id=None,
+        _m_sent=types.SimpleNamespace(mark=lambda: None),
+        send_frame=lambda data: None,  # "kernel" accepts, never drains
+    )
+    # distinct ~400B flood bodies, pre-packed (the pack-once fan-out
+    # shape: the queue sees shared immutable buffers)
+    # only .type matters to the queue when the body is pre-packed
+    msg = StellarMessage(MessageType.TRANSACTION, None)
+    bodies = [b"%08d" % i + b"\xaa" * 392 for i in range(n)]
+    best = float("inf")
+    shed_total = 0
+    high_water = 0
+    critical_sheds = 0
+    for _ in range(reps):
+        sq = SendQueue(peer)
+        t0 = time.perf_counter()
+        for body in bodies:
+            sq.enqueue(msg, body=body)
+        best = min(best, time.perf_counter() - t0)
+        shed_total = sum(sq.shed_msgs)
+        high_water = sq.bytes_high_water
+        # the MEASURED counter (not an assumption): the contract gate in
+        # test_bench / relay reads this value
+        critical_sheds = max(critical_sheds, sq.shed_msgs[CLASS_CRITICAL])
+        assert sq.queued_bytes <= cap_bytes
+        assert sq.shed_msgs[CLASS_FLOOD] > 0
+        sq.close()
+    assert high_water <= cap_bytes, (high_water, cap_bytes)
+    return {
+        "sendq_shed_per_sec": round(shed_total / best, 1),
+        "pushes_per_sec": round(n / best, 1),
+        "sheds": shed_total,
+        "sendq_bytes_high_water": high_water,
+        "cap_bytes": cap_bytes,
+        "critical_sheds": critical_sheds,
+    }
 
 
 def bench_scenario_liveness(matrix="small", only=None, seed=1):

@@ -159,6 +159,18 @@ FINAL_STEPS = [
      [sys.executable, "-u", "profile_kernel.py", "--device-hash-ab",
       "--tpu"],
      1800),
+    # r17: overlay survival plane — the slow_reader + overload_storm
+    # chaos legs re-certified each green window.  Scenario verdicts make
+    # the CLI exit 1 when overload_storm misses its liveness floor, when
+    # a per-peer queue-byte high-water exceeds the configured cap, when
+    # any CRITICAL-class frame is shed anywhere in the matrix, or when
+    # the slow_reader straggler is not disconnected inside the stall
+    # budget — relay-independent, runs next to the perf numbers the
+    # backpressure plane must not regress.
+    ("overlay_shed_r17",
+     [sys.executable, "-u", "-m", "stellar_tpu.scenarios",
+      "--only", "slow_reader,overload_storm", "--json"],
+     900),
 ]
 ALL_NAMES = (
     [s[0] for s in SCRIPT_STEPS]

@@ -396,6 +396,66 @@ class DeterminismRule(Rule):
 
 
 @register
+class SendPathRule(Rule):
+    """The overlay survival plane (r17): ``Peer.send_message`` → SendQueue
+    is the ONLY legal outbound path.  MAC sequence numbers are assigned at
+    the queue's drain (``sendqueue._emit``), so a direct ``send_frame()``
+    call anywhere else either double-assigns a sequence number or sends
+    un-MAC'd bytes, and it bypasses the byte caps, the class priorities,
+    and the straggler detection — the exact unbounded-buffer hole the
+    plane closes.  ``out_queue.append`` is the loopback transport's
+    internal frame motion and belongs to its drain methods only."""
+
+    id = "send-path"
+    doc = (
+        "direct send_frame()/out_queue.append() outside sendqueue.py and"
+        " the transport drains — the bounded priority queue is the only"
+        " legal send path"
+    )
+
+    # the queue's _emit is the single sanctioned send_frame caller
+    QUEUE_FILE = "overlay/sendqueue.py"
+    # transport-internal out_queue motion: the loopback drain itself
+    DRAIN_FUNCS = {
+        "overlay/loopback.py": {"send_frame", "deliver_one"},
+    }
+
+    def applies(self, ctx: FileContext) -> bool:
+        if ctx.relpath == self.QUEUE_FILE:
+            return False
+        return "send_frame" in ctx.text or "out_queue" in ctx.text
+
+    def check(self, ctx: FileContext) -> Iterator[Hit]:
+        drain_funcs = self.DRAIN_FUNCS.get(ctx.relpath, set())
+        for node in _walk(ctx):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not isinstance(f, ast.Attribute):
+                continue
+            if f.attr == "send_frame":
+                yield (
+                    node.lineno,
+                    "direct send_frame() bypasses the SendQueue choke"
+                    " point (caps, class priority, straggler detection,"
+                    " drain-time MAC sequencing) — route through"
+                    " peer.send_message()",
+                )
+            elif f.attr == "append":
+                chain = attr_chain(f.value)
+                if not chain or "out_queue" not in chain:
+                    continue
+                if ctx.enclosing_function(node) in drain_funcs:
+                    continue
+                yield (
+                    node.lineno,
+                    "out_queue.append() outside the loopback transport"
+                    " drain — frames must enter the wire through the"
+                    " SendQueue's release",
+                )
+
+
+@register
 class MetricsFastLaneRule(Rule):
     """The PR 3 metrics fast lane keeps a close-path record at one tuple +
     deque append; registry-built metrics (``app.metrics.new_*``) ride it.

@@ -142,6 +142,26 @@ class Config:
         self.MAX_CONCURRENT_SUBPROCESSES = 16
         self.MINIMUM_IDLE_PERCENT = 0
         self.PARANOID_MODE = False
+        # TPU-native addition: the overlay survival plane
+        # (overlay/sendqueue.py) — every peer owns a bounded,
+        # priority-classed outbound queue (CRITICAL > FETCH > FLOOD >
+        # GOSSIP); MAC sequence numbers are assigned at DRAIN time so
+        # priority reordering and load shedding stay wire-valid.
+        # OVERLAY_SENDQ_BYTES caps the total queued bytes per peer
+        # (0 = plane off: the reference's unbounded write buffers,
+        # bit-exact); FLOOD/GOSSIP shed oldest-within-class under
+        # pressure, CRITICAL is never shed — a peer whose CRITICAL
+        # head-of-line age exceeds STRAGGLER_STALL_MS, or whose
+        # unsheddable backlog exceeds the byte budget, is disconnected
+        # with ERR_LOAD and lands in peerrecord backoff.
+        self.OVERLAY_SENDQ_BYTES = 2 * 1024 * 1024
+        # per-class queued-message cap for the sheddable classes (FLOOD
+        # tx broadcast, GOSSIP peer exchange); oldest within the class
+        # sheds first
+        self.OVERLAY_SENDQ_FLOOD_MSGS = 1024
+        # CRITICAL head-of-line stall budget: a consensus-critical frame
+        # older than this while still queued marks the peer a straggler
+        self.STRAGGLER_STALL_MS = 5000
         # identity / consensus
         self.NODE_SEED: Optional[SecretKey] = None
         self.NODE_IS_VALIDATOR = False
@@ -351,6 +371,33 @@ class Config:
         ):
             raise ValueError(
                 f"DEVICE_HASH must be a boolean (or 0/1), got {dh!r}"
+            )
+        if not (
+            isinstance(self.OVERLAY_SENDQ_BYTES, int)
+            and not isinstance(self.OVERLAY_SENDQ_BYTES, bool)
+            and self.OVERLAY_SENDQ_BYTES >= 0
+        ):
+            raise ValueError(
+                f"OVERLAY_SENDQ_BYTES must be an int >= 0 (0 = off), "
+                f"got {self.OVERLAY_SENDQ_BYTES!r}"
+            )
+        if not (
+            isinstance(self.OVERLAY_SENDQ_FLOOD_MSGS, int)
+            and not isinstance(self.OVERLAY_SENDQ_FLOOD_MSGS, bool)
+            and self.OVERLAY_SENDQ_FLOOD_MSGS >= 1
+        ):
+            raise ValueError(
+                f"OVERLAY_SENDQ_FLOOD_MSGS must be an int >= 1, "
+                f"got {self.OVERLAY_SENDQ_FLOOD_MSGS!r}"
+            )
+        if not (
+            isinstance(self.STRAGGLER_STALL_MS, (int, float))
+            and not isinstance(self.STRAGGLER_STALL_MS, bool)
+            and self.STRAGGLER_STALL_MS > 0
+        ):
+            raise ValueError(
+                f"STRAGGLER_STALL_MS must be a number > 0, "
+                f"got {self.STRAGGLER_STALL_MS!r}"
             )
         if not (
             isinstance(self.SIG_VERIFY_STREAMS, int)

@@ -14,10 +14,12 @@ from .manager import OverlayManager
 from .peer import Peer, PeerRole, PeerState
 from .peerauth import PeerAuth
 from .peerrecord import PeerRecord
+from .sendqueue import SendQueue, SendQueueStats
 from .tcppeer import PeerDoor, TCPPeer
 
 __all__ = [
     "Floodgate", "ItemFetcher", "Tracker", "LoopbackPeer",
     "LoopbackPeerConnection", "OverlayManager", "Peer", "PeerRole",
     "PeerState", "PeerAuth", "PeerRecord", "PeerDoor", "TCPPeer",
+    "SendQueue", "SendQueueStats",
 ]

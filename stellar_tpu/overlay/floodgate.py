@@ -12,7 +12,7 @@ from typing import Dict, Set
 from ..crypto import sha256
 from ..trace import tracer_of
 from ..util import xlog
-from ..xdr.base import xdr_to_opaque
+from ..xdr.base import pack_many, xdr_to_opaque
 from ..xdr.overlay import StellarMessage
 
 log = xlog.logger("Overlay")
@@ -38,8 +38,11 @@ class Floodgate:
         self.n_sent = 0
 
     @staticmethod
-    def message_key(msg: StellarMessage) -> bytes:
-        return sha256(msg.to_xdr())
+    def message_key(msg: StellarMessage, body: bytes = None) -> bytes:
+        """Flood identity = hash of the packed message; ``body`` lets a
+        caller that already packed the message (broadcast's pack-once
+        fan-out) skip the re-serialization."""
+        return sha256(body if body is not None else msg.to_xdr())
 
     def clear_below(self, current_ledger: int) -> None:
         """Drop records older than the previous ledger (Floodgate.cpp:46)."""
@@ -76,7 +79,12 @@ class Floodgate:
             return
         tracer = tracer_of(self.app)
         sp = tracer.begin("overlay.flood")
-        key = self.message_key(msg)
+        # pack-once fan-out: ONE serialization (the C pack_many path)
+        # serves the flood key and every peer's send queue — each queue
+        # entry holds a reference to this same immutable buffer, so a
+        # 100-peer flood never re-serializes and shedding is O(1)
+        body = pack_many([msg], StellarMessage)
+        key = self.message_key(msg, body)
         rec = self.flood_map.get(key)
         if rec is None or force:
             lm = self.app.ledger_manager
@@ -89,7 +97,7 @@ class Floodgate:
         for peer in list(om.authenticated_peers()):
             if peer not in rec.peers_told:
                 rec.peers_told.add(peer)
-                peer.send_message(msg)
+                peer.send_message(msg, body=body)
                 sent += 1
         self.n_sent += sent
         tracer.end(

@@ -57,6 +57,9 @@ class LoadManager:
         self.app = app
         self._costs: OrderedDict[bytes, PeerCosts] = OrderedDict()
         self._shed_meter = app.metrics.new_meter(("overlay", "drop", "load-shed"), "drop")
+        # receive-side shed decisions, read by the chaos scoreboard next
+        # to the send-side (SendQueue) shed counters
+        self.n_sheds = 0
         # recent-load window for the idle estimate
         self._window_start = time.monotonic()
         self._busy_seconds = 0.0
@@ -129,6 +132,7 @@ class LoadManager:
                 min_idle,
             )
             self._shed_meter.mark()
+            self.n_sheds += 1
             worst.drop()
         self._reset_window()
 

@@ -32,19 +32,25 @@ MAX_QUEUE_DEPTH = 1000
 class FaultProfile:
     """One link side's fault knobs, as the chaos plane schedules them
     (stellar_tpu/scenarios/faults.py).  ``latency`` is seconds of delivery
-    delay on the link; the probabilistic knobs map 1:1 onto the
-    LoopbackPeer attributes of the same name.  NOTE: post-handshake, any
+    delay on the link; ``drain`` is a byte-rate cap (bytes/sec, 0 =
+    unlimited) modeling a SLOW READER — scheduled pumps deliver at most
+    their interval's byte budget and leave the rest queued, so the
+    sender's transport backs up exactly like a peer that stops reading
+    its socket; the probabilistic knobs map 1:1 onto the LoopbackPeer
+    attributes of the same name.  NOTE: post-handshake, any
     drop/duplicate/reorder/damage that actually fires breaks the peers'
     MAC sequence and costs the CONNECTION (exactly like losing bytes
     inside a TCP stream) — a lossy profile therefore models link FLAPS,
     and liveness comes from the scenario's link doctor re-establishing
-    the pair plus SCP rebroadcast."""
+    the pair plus SCP rebroadcast.  A pure drain cap delivers whole
+    frames in order and never flaps."""
 
     drop: float = 0.0
     duplicate: float = 0.0
     reorder: float = 0.0
     damage: float = 0.0
     latency: float = 0.0
+    drain: float = 0.0
 
     def apply(self, peer: "LoopbackPeer", seed: Optional[int] = None) -> None:
         peer.drop_prob = self.drop
@@ -52,6 +58,7 @@ class FaultProfile:
         peer.reorder_prob = self.reorder
         peer.damage_prob = self.damage
         peer.latency = self.latency
+        peer.drain_rate = self.drain
         if seed is not None:
             # scenario-scoped determinism: the per-process ctor nonce makes
             # pairs uncorrelated but NOT replayable across two runs in one
@@ -82,6 +89,12 @@ class LoopbackPeer(Peer):
         # this many (clock) seconds — frames sent while the pump is armed
         # ride the same delayed batch, the "slow link" shape
         self.latency = 0.0
+        # slow-reader mode: >0 caps delivery at this many bytes/sec —
+        # each scheduled pump spends one interval's byte budget and the
+        # remainder waits, so the transport genuinely backs up (the shape
+        # the send queue's shed/straggler plane defends against)
+        self.drain_rate = 0.0
+        self._drain_tokens = 0.0  # deficit-carrying byte budget (see _pump)
         self._latency_timer: Optional[VirtualTimer] = None
         self._latency_armed = False
         # seeded: fault-injection rolls (drop/damage/reorder) must replay
@@ -104,8 +117,15 @@ class LoopbackPeer(Peer):
         if self._closed or self.remote is None:
             return
         self.out_queue.append(data)
-        while len(self.out_queue) > self.max_queue_depth:
-            self.out_queue.popleft()  # shed oldest (queue-bounded transport)
+        if not self.send_queue.active:
+            # legacy bounded transport (knob-off only): indiscriminate
+            # shed-oldest at depth.  With the survival plane on, the
+            # class-aware SendQueue is the bounding layer and its
+            # in-flight window keeps this deque small — shedding frames
+            # that already consumed a MAC sequence number here would
+            # break the receiver's sequence check.
+            while len(self.out_queue) > self.max_queue_depth:
+                self.out_queue.popleft()
         if not self.corked:
             self._schedule_delivery()
 
@@ -124,15 +144,19 @@ class LoopbackPeer(Peer):
         """Move one queued frame into the remote peer, applying faults."""
         if self.remote is None or not self.out_queue:
             return False
-        # like TCPPeer (which stamps on kernel-accepted bytes), write
-        # progress is stamped when a frame actually moves on the "wire" —
-        # a peer whose output only ever piles into a shedding queue makes
-        # no progress and must trip the idle write timeout (advisor r03)
-        self.wrote_bytes()
         entry = self.out_queue.popleft()
         # entries re-queued by a fault are marked stale so the duplicate /
         # reorder faults can't recurse and delivery always terminates
         data, fresh = entry if isinstance(entry, tuple) else (entry, True)
+        # like TCPPeer (which stamps on kernel-accepted bytes), write
+        # progress is stamped when a frame actually moves on the "wire" —
+        # a peer whose output only ever piles into a shedding queue makes
+        # no progress and must trip the idle write timeout (advisor r03);
+        # the byte count credits the send queue's in-flight window.
+        # Fault-requeued (stale) entries were charged to the window only
+        # ONCE, so only the fresh pass credits it — a double credit would
+        # over-open the window and drift the transport bound.
+        self.wrote_bytes(len(data) if fresh else 0)
 
         if self.drop_prob > 0 and self._rng.random() < self.drop_prob:
             log.debug("loopback dropping frame")
@@ -171,14 +195,20 @@ class LoopbackPeer(Peer):
     def drop_all(self) -> None:
         self.out_queue.clear()
 
+    # pump cadence for a drain-limited link with no latency set: the
+    # byte budget per pump window is drain_rate * interval
+    DRAIN_TICK = 0.05
+
     def _schedule_delivery(self) -> None:
-        if self.latency > 0:
+        if self.latency > 0 or self.drain_rate > 0:
             if self._latency_armed:
                 return  # queued frames ride the already-armed pump
             if self._latency_timer is None:
                 self._latency_timer = VirtualTimer(self.app.clock)
             self._latency_armed = True
-            self._latency_timer.expires_from_now(self.latency)
+            self._latency_timer.expires_from_now(
+                self.latency if self.latency > 0 else self.DRAIN_TICK
+            )
             self._latency_timer.async_wait(self._latency_pump)
         else:
             self.app.clock.post(self._pump)
@@ -187,13 +217,43 @@ class LoopbackPeer(Peer):
         self._latency_armed = False
         self._pump()
         # frames that arrived while this pump ran (or that a fault
-        # re-queued) wait a fresh latency window, like bytes behind a
-        # slow link's send buffer
+        # re-queued, or that the drain cap left behind) wait a fresh
+        # window, like bytes behind a slow link's send buffer
         if self.out_queue and not self.corked and not self._closed:
             self._schedule_delivery()
 
     def _pump(self) -> None:
-        if not self.corked:
+        if self.corked:
+            return
+        if self.drain_rate > 0:
+            # slow reader: token bucket with deficit carry — each window
+            # adds rate*interval tokens; a frame bigger than one window's
+            # quantum drives the balance negative and later windows pay
+            # the debt off, so the AVERAGE rate equals the configured
+            # bytes/sec regardless of frame size (no per-tick
+            # at-least-one-frame under-throttle).  Whole frames, in
+            # order, never faulted by the cap itself.
+            interval = self.latency if self.latency > 0 else self.DRAIN_TICK
+            quantum = self.drain_rate * interval
+            self._drain_tokens += quantum
+            if not self.out_queue:
+                # idle links must not bank unbounded burst credit
+                self._drain_tokens = min(self._drain_tokens, quantum)
+            while self.out_queue and self._drain_tokens > 0:
+                head = self.out_queue[0]
+                data, fresh = (
+                    head if isinstance(head, tuple) else (head, True)
+                )
+                if fresh:
+                    # fault-requeued (stale) entries were billed on
+                    # their first pass — mirroring the wrote_bytes
+                    # fresh-only credit below, or a reorder/duplicate
+                    # fault under a drain cap would double-charge the
+                    # budget and sink the link below its configured rate
+                    self._drain_tokens -= len(data)
+                if not self.deliver_one():
+                    break
+        else:
             self.deliver_all()
 
     def set_corked(self, corked: bool) -> None:

@@ -20,6 +20,7 @@ from .itemfetcher import ItemFetcher
 from .peer import Peer, PeerRole, PeerState
 from .peerauth import PeerAuth
 from .peerrecord import PeerRecord
+from .sendqueue import SendQueueStats
 
 log = xlog.logger("Overlay")
 
@@ -41,6 +42,11 @@ class OverlayManager:
         from .loadmanager import LoadManager
 
         self.load_manager = LoadManager(app)
+        # node-level aggregate over every peer's SendQueue (peers die
+        # with their connections; the chaos scoreboard and /peers need
+        # the surviving view): per-class sheds, straggler disconnects,
+        # queue-byte high-water, max observed CRITICAL stall
+        self.sendq_stats = SendQueueStats()
         # per-crank SCP envelope coalescing (enqueue_scp_envelope)
         self._scp_batch: List = []
         self._scp_flush_posted = False
@@ -269,4 +275,5 @@ class OverlayManager:
                 for p in self.peers
             ],
             "authenticated_count": self.get_authenticated_peer_count(),
+            "sendq": self.sendq_stats.to_dict(),
         }

@@ -20,11 +20,12 @@ from __future__ import annotations
 from typing import List, Optional
 
 from ..crypto import sha256
-from ..crypto.sha import hmac_sha256, hmac_sha256_verify
+from ..crypto.sha import hmac_sha256_verify
 from ..crypto.sodium import randombytes
 from ..util import xlog
 from ..util.clock import VirtualTimer
 from ..xdr.base import uint64, xdr_to_opaque
+from .sendqueue import SendQueue
 from ..xdr.overlay import (
     Auth,
     AuthCert,
@@ -58,9 +59,6 @@ class PeerState:
     CLOSING = 4
 
 
-# message types exempt from MAC/sequence (sent before keys exist)
-_UNMACED = (MessageType.HELLO2, MessageType.ERROR_MSG)
-
 # hot-path dispatch table (resolved per-instance via getattr)
 _DISPATCH = {
     MessageType.ERROR_MSG: "recv_error",
@@ -80,6 +78,11 @@ _DISPATCH = {
 
 
 class Peer:
+    # wire bytes the transport adds around each frame (TCP: 4-byte
+    # length header) — the send queue charges them against its in-flight
+    # window so queue credits balance against raw socket byte counts
+    FRAME_WIRE_OVERHEAD = 0
+
     def __init__(self, app, role: str):
         self.app = app
         self.role = role
@@ -113,6 +116,11 @@ class Peer:
         self.last_read = app.clock.now()
         self.last_write = app.clock.now()
         self._idle_timer = VirtualTimer(app.clock)
+        # the overlay survival plane: bounded priority-classed outbound
+        # queue (overlay/sendqueue.py) — send_message enqueues, the queue
+        # drains into the transport in class order, OVERLAY_SENDQ_BYTES=0
+        # degenerates to the reference's immediate unbounded sends
+        self.send_queue = SendQueue(self)
         self._start_idle_timer()
 
     def io_timeout_seconds(self) -> int:
@@ -123,10 +131,13 @@ class Peer:
         (Peer::receivedBytes — per byte, not per decoded frame)."""
         self.last_read = self.app.clock.now()
 
-    def wrote_bytes(self) -> None:
+    def wrote_bytes(self, n: int = 0) -> None:
         """Transport hook: bytes actually flushed to the wire count as
-        write activity (queued-but-unsent output does not)."""
+        write activity (queued-but-unsent output does not) AND credit the
+        send queue's in-flight window so it can release more frames."""
         self.last_write = self.app.clock.now()
+        if n:
+            self.send_queue.credit(n)
 
     def _start_idle_timer(self) -> None:
         if self.should_abort():
@@ -236,24 +247,36 @@ class Peer:
             )
         self.send_message(StellarMessage(MessageType.PEERS, addrs))
 
-    def send_message(self, msg: StellarMessage) -> None:
-        """Wrap in AuthenticatedMessage (MAC + seq unless handshake/error)
-        and hand to the transport (Peer::sendMessage, Peer.cpp:457-467)."""
+    def send_message(self, msg: StellarMessage, body: bytes = None) -> None:
+        """THE outbound choke point (Peer::sendMessage, Peer.cpp:457-467):
+        classify + enqueue on the survival-plane send queue, which wraps
+        the body in an AuthenticatedMessage (MAC + seq assigned at DRAIN
+        time, unless handshake/error) as it releases frames into the
+        transport.  ``body`` is the pre-packed StellarMessage XDR — the
+        flood fan-out passes ONE shared buffer to every peer."""
         if self.should_abort() and msg.type != MessageType.ERROR_MSG:
             return
-        if msg.type in _UNMACED:
-            amsg = AuthenticatedMessage.v0_of(0, msg, b"\x00" * 32)
-        else:
-            seq = self.send_mac_seq
-            mac = hmac_sha256(self.send_mac_key, xdr_to_opaque((uint64, seq), msg))
-            self.send_mac_seq += 1
-            amsg = AuthenticatedMessage.v0_of(seq, msg, mac)
-        self._m_sent.mark()
-        frame = amsg.to_xdr()
-        lm = getattr(self.app.overlay_manager, "load_manager", None)
-        if lm is not None and self.peer_id is not None:
-            lm.get_peer_costs(bytes(self.peer_id.value)).bytes_send += len(frame)
-        self.send_frame(frame)
+        # the sent-message meter and bytes_send both mark at the queue's
+        # DRAIN (sendqueue._emit) — a shed frame never counted as sent
+        self.send_queue.enqueue(msg, body)
+
+    def note_straggler_backoff(self) -> None:
+        """A straggler disconnect (ERR_LOAD) lands the peer's address in
+        peerrecord backoff, so the next overlay tick does not instantly
+        redial a connection we just shed for being underwater."""
+        from .peerrecord import PeerRecord
+
+        ip = self.ip()
+        port = self.remote_listening_port
+        if not ip or not port:
+            return
+        try:
+            pr = PeerRecord.load(self.app.database, ip, port) or PeerRecord(
+                ip, port
+            )
+            pr.back_off(self.app.database, self.app.clock.now())
+        except Exception as e:  # DB closing mid-teardown must not mask the drop
+            log.warning("could not back off straggler %s:%d: %s", ip, port, e)
 
     # -- inbound ------------------------------------------------------------
     def recv_frame(self, data: bytes) -> None:
@@ -487,12 +510,19 @@ class Peer:
             return
         if code is not None:
             try:
+                # the goodbye frame must not queue behind the congestion
+                # that may have caused this drop — emit it straight into
+                # the transport like the reference's direct write (the
+                # straggler path already runs in bypass by the time it
+                # gets here)
+                self.send_queue.bypass()
                 self.send_error(code, text)
             except Exception:
                 pass
         self.state = PeerState.CLOSING
         self._m_drop.mark()
         self._idle_timer.cancel()
+        self.send_queue.close()
         om = self.app.overlay_manager
         if om is not None:
             om.drop_peer(self)

@@ -24,6 +24,11 @@ HDR_SIZE = 4
 
 
 class TCPPeer(Peer):
+    # the 4-byte length header send_frame prepends: charged by the send
+    # queue per frame, credited back through wrote_bytes(n) as the kernel
+    # accepts raw wire bytes — charge and credit balance exactly
+    FRAME_WIRE_OVERHEAD = HDR_SIZE
+
     def __init__(self, app, role: str, sock: socket.socket):
         super().__init__(app, role)
         self.sock = sock
@@ -31,6 +36,7 @@ class TCPPeer(Peer):
         self._rbuf = bytearray()
         self._wbuf: Deque[bytes] = deque()
         self._wpos = 0
+        self._writing = False
         self._connecting = role == PeerRole.WE_CALLED_REMOTE
         self._closed = False
         self._peer_ip = ""
@@ -130,22 +136,34 @@ class TCPPeer(Peer):
                 return
 
     def _do_write(self) -> None:
-        while self._wbuf:
-            buf = self._wbuf[0]
-            try:
-                n = self.sock.send(buf[self._wpos :])
-            except (BlockingIOError, InterruptedError):
-                return
-            except OSError as e:
-                log.info("write error to %r: %s", self, e)
-                self.drop()
-                return
-            if n > 0:
-                self.wrote_bytes()  # only bytes accepted by the kernel
-            self._wpos += n
-            if self._wpos >= len(buf):
-                self._wbuf.popleft()
-                self._wpos = 0
+        # reentrancy guard: wrote_bytes(n) credits the send queue, whose
+        # drain may emit a fresh frame -> send_frame -> back here while
+        # the outer loop is mid-entry.  The nested call is a no-op; the
+        # outer loop picks the appended frames up naturally.
+        if self._writing:
+            return
+        self._writing = True
+        try:
+            while self._wbuf:
+                buf = self._wbuf[0]
+                try:
+                    n = self.sock.send(buf[self._wpos :])
+                except (BlockingIOError, InterruptedError):
+                    return
+                except OSError as e:
+                    log.info("write error to %r: %s", self, e)
+                    self.drop()
+                    return
+                if n > 0:
+                    # only bytes accepted by the kernel count as progress
+                    # — and they credit the send queue's in-flight window
+                    self.wrote_bytes(n)
+                self._wpos += n
+                if self._wpos >= len(buf):
+                    self._wbuf.popleft()
+                    self._wpos = 0
+        finally:
+            self._writing = False
 
     # -- Peer transport interface -------------------------------------------
     def send_frame(self, data: bytes) -> None:

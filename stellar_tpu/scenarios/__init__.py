@@ -12,9 +12,11 @@ from .faults import (  # noqa: F401
     ByzantineFlood,
     CrashRestart,
     Fault,
+    OverloadStorm,
     Partition,
     PartitionUntilCheckpoint,
     SlowLossyLinks,
+    SlowReader,
 )
 from .matrix import (  # noqa: F401
     FAULT_CLASSES,
@@ -29,6 +31,8 @@ __all__ = [
     "ByzantineFlood",
     "CrashRestart",
     "Fault",
+    "OverloadStorm",
+    "SlowReader",
     "Partition",
     "PartitionUntilCheckpoint",
     "SlowLossyLinks",

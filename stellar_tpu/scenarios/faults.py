@@ -407,6 +407,152 @@ class ByzantineFlood(Fault):
 
 
 @dataclass
+class SlowReader(Fault):
+    """The overlay survival plane's defining adversary (ISSUE r17): one
+    peer drains its links at a fraction of the offered rate — the
+    crashed-but-connected / underpowered / hostile slow reader.  Every
+    link touching node ``node`` gets a ``FaultProfile(drain=...)`` byte
+    -rate cap at ``at`` (whole frames, in order, no flaps), so its
+    NEIGHBORS' transports back up: their send queues shed FLOOD toward
+    it, keep CRITICAL first, and — once the CRITICAL head-of-line age
+    crosses STRAGGLER_STALL_MS — disconnect it with ERR_LOAD inside the
+    stall budget.  The link doctor re-establishes the pair (profile
+    carried over), so the cycle repeats for the whole window; the
+    consensus floor is asserted over the OTHER nodes."""
+
+    at: float
+    node: int
+    drain_bytes_per_sec: float = 4096.0
+    heal_at: Optional[float] = None
+
+    def arm(self, scn) -> None:
+        key = scn.node_keys[self.node]
+        raw = scn.sim._raw_key(key)
+        links = [
+            (ia, ib) for (ia, ib) in scn.sim.links if raw in (ia, ib)
+        ]
+
+        def degrade():
+            for ia, ib in links:
+                scn.sim.set_link_faults(
+                    FaultProfile(drain=self.drain_bytes_per_sec), ia, ib
+                )
+            scn.note(
+                "slow reader: node %d drains at %d B/s from t=%.1f"
+                % (self.node, self.drain_bytes_per_sec, scn.elapsed())
+            )
+
+        self._at(scn, self.at, degrade)
+        if self.heal_at is not None:
+            def restore():
+                for ia, ib in links:
+                    scn.sim.set_link_faults(FaultProfile(), ia, ib)
+                scn.sim.ensure_links()
+                scn.mark_recovery_start()
+                scn.note("slow reader healed at t=%.1f" % scn.elapsed())
+
+            self._at(scn, self.heal_at, restore)
+
+
+@dataclass
+class OverloadStorm(Fault):
+    """Saturating tx-broadcast overload (ISSUE r17): every link is
+    drain-capped at ``drain_bytes_per_sec`` and node ``source`` floods
+    distinct invalid-signature TRANSACTION messages at several times that
+    capacity between ``at`` and ``until``.  Without per-peer send-side
+    bounding this queues consensus traffic behind the flood and grows the
+    write buffers without bound; with the survival plane on, FLOOD sheds
+    (metered), CRITICAL jumps every queue, the per-peer byte high-water
+    stays under OVERLAY_SENDQ_BYTES, and the liveness floor holds.  The
+    storm pool is pre-built at arm time from the scenario seed
+    (deterministic replay; injection never competes for signing CPU)."""
+
+    at: float
+    until: float
+    source: int = 0
+    msgs_per_tick: int = 30
+    tick: float = 0.25
+    drain_bytes_per_sec: float = 16384.0
+
+    def __post_init__(self):
+        self.n_storm = 0
+        self._pool: List = []
+
+    def arm(self, scn) -> None:
+        self._rng = random.Random(scn.spec.seed ^ 0x570A4)
+        self._build_pool(scn)
+
+        def degrade():
+            scn.sim.set_link_faults(
+                FaultProfile(drain=self.drain_bytes_per_sec)
+            )
+            scn.note(
+                "overload storm: all links drain at %d B/s, %d tx/tick"
+                % (self.drain_bytes_per_sec, self.msgs_per_tick)
+            )
+            self._tick_fn(scn)
+
+        def restore():
+            scn.sim.set_link_faults(FaultProfile())
+            scn.sim.ensure_links()
+            scn.note("overload storm over at t=%.1f" % scn.elapsed())
+
+        self._at(scn, self.at, degrade)
+        self._at(scn, self.until, restore)
+
+    def _build_pool(self, scn) -> None:
+        """Distinct structurally-valid transactions with corrupted
+        signatures (receivers fast-reject at the strict gate), packed
+        once each — the flood rides broadcast_message's pack-once
+        fan-out, so the storm's cost lands on the SEND queues."""
+        from ..crypto.keys import SecretKey
+        from ..tx import testutils as T
+        from ..tx.frame import TransactionFrame
+        import stellar_tpu.xdr as X
+
+        app = scn.sim.nodes[scn.sim._raw_key(scn.node_keys[self.source])]
+        n_ticks = int((self.until - self.at) / self.tick) + 2
+        for i in range(self.msgs_per_tick * n_ticks):
+            src = SecretKey.pseudo_random_for_testing(
+                60_000_000 + self._rng.randrange(1 << 30)
+            )
+            dst = SecretKey.pseudo_random_for_testing(
+                60_000_000 + self._rng.randrange(1 << 30)
+            )
+            tx = X.Transaction(
+                sourceAccount=src.get_public_key(),
+                fee=100,
+                seqNum=self._rng.randrange(1, 1 << 40),
+                timeBounds=None,
+                memo=X.Memo.none(),
+                operations=[T.payment_op(dst, 1)],
+                ext=0,
+            )
+            frame = TransactionFrame(
+                app.network_id, X.TransactionEnvelope(tx, [])
+            )
+            frame.add_signature(src)
+            sig = bytearray(frame.envelope.signatures[0].signature)
+            sig[0] ^= 0xFF
+            frame.envelope.signatures[0].signature = bytes(sig)
+            self._pool.append(frame.to_stellar_message())
+
+    def _tick_fn(self, scn) -> None:
+        if scn.elapsed_since_arm() >= self.until or scn.done:
+            return
+        app = scn.sim.nodes.get(
+            scn.sim._raw_key(scn.node_keys[self.source])
+        )
+        if app is not None:
+            for _ in range(self.msgs_per_tick):
+                if not self._pool:
+                    break
+                app.overlay_manager.broadcast_message(self._pool.pop())
+                self.n_storm += 1
+        self._at(scn, self.tick, lambda: self._tick_fn(scn), slot='tick')
+
+
+@dataclass
 class PartitionUntilCheckpoint(Fault):
     """The catchup-under-load shape: partition ``lagger`` off at ``at``
     and heal only once the majority's LCL has crossed

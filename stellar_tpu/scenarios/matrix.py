@@ -27,6 +27,15 @@ Fault classes (ROADMAP #5 / ISSUE r12 acceptance):
                           while the network closes through checkpoint
                           boundaries under load; rejoin via history-archive
                           catchup (REAL_TIME clock, like the history suite)
+- ``slow_reader``       — one tier peer drains its links at a fraction of
+                          the offered rate (ISSUE r17): neighbors shed
+                          FLOOD toward it, never CRITICAL, and disconnect
+                          it (ERR_LOAD) inside the straggler stall budget;
+                          consensus floor asserted over everyone else
+- ``overload_storm``    — tx flood at several times total drain capacity
+                          across all links: FLOOD sheds at every queue,
+                          CRITICAL jumps them, queue-byte high-water stays
+                          under OVERLAY_SENDQ_BYTES, liveness floor holds
 """
 
 from __future__ import annotations
@@ -37,9 +46,11 @@ from ..overlay.loopback import FaultProfile
 from .faults import (
     ByzantineFlood,
     CrashRestart,
+    OverloadStorm,
     Partition,
     PartitionUntilCheckpoint,
     SlowLossyLinks,
+    SlowReader,
 )
 from .scenario import Scenario, ScenarioResult, ScenarioSpec
 
@@ -50,6 +61,8 @@ FAULT_CLASSES = (
     "slow_lossy",
     "crash_restart",
     "catchup_load",
+    "slow_reader",
+    "overload_storm",
 )
 
 
@@ -156,6 +169,65 @@ def small_specs(seed: int = 1) -> Dict[str, ScenarioSpec]:
             max_recovery_ms=20_000,
             timeout=240.0,
         ),
+        # the overlay survival plane's two shapes (ISSUE r17).  Caps are
+        # deliberately SMALL (32 KiB vs the 2 MiB production default) so
+        # the defenses engage at test-scale traffic; every knob is a
+        # per-node Config override through the spec.
+        "slow_reader": ScenarioSpec(
+            name="slow_reader_small",
+            fault_class="slow_reader",
+            # 3-core mesh + 2-node tier ring; the slow reader is tier
+            # node 4 (links to tier node 3 + core node 1): its quorum
+            # slice rides the core, so disconnecting it costs nobody
+            # else a vote
+            topology="core_and_tier",
+            n_nodes=3,
+            tier_n=2,
+            seed=seed,
+            sendq_bytes=32 * 1024,
+            sendq_flood_msgs=64,
+            straggler_stall_ms=1500,
+            faults=[
+                SlowReader(at=0.5, node=4, drain_bytes_per_sec=2048)
+            ],
+            load_txs=600,
+            load_rate=50,
+            # the straggler cannot meet the floor it is built to miss
+            liveness_exclude=[4],
+            expect_straggler_disconnect=True,
+            min_flood_sheds=1,
+            assert_high_water_bounded=True,
+            target_ledgers=14,
+            min_ledgers_per_sec=0.2,
+            timeout=240.0,
+        ),
+        "overload_storm": ScenarioSpec(
+            name="overload_storm_small",
+            fault_class="overload_storm",
+            n_nodes=3,
+            seed=seed,
+            sendq_bytes=32 * 1024,
+            sendq_flood_msgs=48,
+            straggler_stall_ms=2500,
+            faults=[
+                OverloadStorm(
+                    at=0.5, until=8.0, source=0,
+                    msgs_per_tick=30, tick=0.25,
+                    drain_bytes_per_sec=16384,
+                )
+            ],
+            # light legit load: the storm supplies the flood pressure;
+            # txsets stay small enough that FETCH replies clear the
+            # drain-capped links
+            load_accounts=4,
+            load_txs=120,
+            load_rate=15,
+            min_flood_sheds=10,
+            assert_high_water_bounded=True,
+            target_ledgers=14,
+            min_ledgers_per_sec=0.2,
+            timeout=240.0,
+        ),
         "catchup_load": ScenarioSpec(
             name="catchup_load_small",
             fault_class="catchup_load",
@@ -233,6 +305,21 @@ def big_specs(seed: int = 1) -> Dict[str, ScenarioSpec]:
                 )
             ]
             big.target_ledgers = 26
+        elif cls == "slow_reader":
+            # 4-core + 4-tier ring; the slow reader is the last tier node
+            big.faults = [
+                SlowReader(at=0.5, node=7, drain_bytes_per_sec=2048)
+            ]
+            big.liveness_exclude = [7]
+        elif cls == "overload_storm":
+            big.faults = [
+                OverloadStorm(
+                    at=0.5, until=20.0, source=0,
+                    msgs_per_tick=80, tick=0.25,
+                    drain_bytes_per_sec=16384,
+                )
+            ]
+            big.load_txs = 300
         out[cls] = big
     return out
 

@@ -24,7 +24,7 @@ from __future__ import annotations
 
 import shutil
 import tempfile
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import List, Optional
 
 from ..simulation import LoadGenerator, Simulation, topologies
@@ -40,6 +40,11 @@ log = xlog.logger("Scenario")
 # scenario node instance numbers start high so tmp/bucket dirs never
 # collide with the unit suites' get_test_config(0..n) apps
 _INSTANCE_BASE = 9100
+
+# slack on the straggler-disconnect window verdict: the stall timer
+# fires at the CRITICAL head's deadline on the virtual clock, so the
+# recorded stall age sits AT the budget; this absorbs crank granularity
+_STALL_POLL_SLACK_MS = 250.0
 
 
 @dataclass
@@ -63,12 +68,29 @@ class ScenarioSpec:
     load_rate: int = 40
     load_backlog_ledgers: int = 0
     load_target: int = 0
+    # overlay survival plane (overlay/sendqueue.py) — None keeps the
+    # Config default on every node; 0 for sendq_bytes turns the plane
+    # off (the knob-off transparency leg)
+    sendq_bytes: Optional[int] = None
+    sendq_flood_msgs: Optional[int] = None
+    straggler_stall_ms: Optional[float] = None
+    # floors/verdicts for the survival plane: a run must disconnect at
+    # least one straggler (slow_reader), must shed at least this many
+    # FLOOD frames (overload shapes), and the per-peer queue-byte
+    # high-water must stay under the configured cap when set
+    expect_straggler_disconnect: bool = False
+    min_flood_sheds: int = 0
+    assert_high_water_bounded: bool = False
     # liveness target + floors
     target_ledgers: int = 12  # absolute min LCL across nodes at the end
     stabilize_ledgers: int = 2
     timeout: float = 300.0
     min_ledgers_per_sec: float = 0.0
     max_recovery_ms: Optional[float] = None
+    # node indices EXCLUDED from the liveness target/floor (a deliberate
+    # straggler cannot gate the consensus floor it is designed to miss);
+    # chain agreement still covers them at the lowest common sequence
+    liveness_exclude: List[int] = field(default_factory=list)
     # infrastructure
     disk_db: bool = False  # crash/restart needs on-disk sqlite
     archives: bool = False  # catchup needs a history archive
@@ -139,6 +161,12 @@ class Scenario:
         cfg.MANUAL_CLOSE = False
         cfg.ARTIFICIALLY_ACCELERATE_TIME_FOR_TESTING = True
         cfg.SCP_SIG_SCHEME = self.spec.scp_sig_scheme
+        if self.spec.sendq_bytes is not None:
+            cfg.OVERLAY_SENDQ_BYTES = self.spec.sendq_bytes
+        if self.spec.sendq_flood_msgs is not None:
+            cfg.OVERLAY_SENDQ_FLOOD_MSGS = self.spec.sendq_flood_msgs
+        if self.spec.straggler_stall_ms is not None:
+            cfg.STRAGGLER_STALL_MS = self.spec.straggler_stall_ms
         if self.spec.disk_db or self.spec.archives:
             cfg.DATABASE = f"sqlite3://{self.workdir}/node{i}.db"
         if self.spec.archives:
@@ -259,6 +287,7 @@ class Scenario:
                 sim,
                 before,
                 after,
+                exclude_nodes=self._excluded_prefixes(),
                 scenario=spec.name,
                 fault_class=spec.fault_class,
                 seed=spec.seed,
@@ -291,6 +320,72 @@ class Scenario:
                     "recovery floor miss: %s ms (max %.0f)"
                     % (sb.recovery_ms, spec.max_recovery_ms)
                 )
+            # overlay survival plane verdicts — CRITICAL is never shed,
+            # in ANY scenario (the tentpole contract)
+            if sb.sendq_sheds.get("critical", 0):
+                failures.append(
+                    "%d CRITICAL-class frames shed from a send queue —"
+                    " consensus traffic must never shed"
+                    % sb.sendq_sheds["critical"]
+                )
+            if (
+                spec.min_flood_sheds
+                and sb.sendq_sheds.get("flood", 0) < spec.min_flood_sheds
+            ):
+                failures.append(
+                    "expected >= %d FLOOD-class sheds under overload, got %d"
+                    % (spec.min_flood_sheds, sb.sendq_sheds.get("flood", 0))
+                )
+            if spec.assert_high_water_bounded:
+                cap = (
+                    spec.sendq_bytes
+                    if spec.sendq_bytes is not None
+                    else self._cfg(0).OVERLAY_SENDQ_BYTES
+                )
+                if cap and sb.sendq_bytes_high_water > cap:
+                    if sb.sendq_oversized_admits == 0:
+                        failures.append(
+                            "per-peer queue-byte high-water %d exceeds"
+                            " the configured cap %d"
+                            % (sb.sendq_bytes_high_water, cap)
+                        )
+                    else:
+                        # an oversized unsheddable frame admitted alone
+                        # relaxes the documented per-peer bound to
+                        # max(cap, that frame) — report, don't fail
+                        sb.notes.append(
+                            "high-water %d over cap %d under %d"
+                            " oversized admit(s) — the documented"
+                            " max(cap, one frame) bound applies"
+                            % (
+                                sb.sendq_bytes_high_water,
+                                cap,
+                                sb.sendq_oversized_admits,
+                            )
+                        )
+            if spec.expect_straggler_disconnect:
+                stall_budget = (
+                    spec.straggler_stall_ms
+                    if spec.straggler_stall_ms is not None
+                    else self._cfg(0).STRAGGLER_STALL_MS
+                )
+                if sb.sendq_straggler_disconnects < 1:
+                    failures.append(
+                        "expected a straggler disconnect (ERR_LOAD) and"
+                        " none happened"
+                    )
+                elif (
+                    sb.sendq_max_stall_ms
+                    > stall_budget + 1.5 * _STALL_POLL_SLACK_MS
+                ):
+                    # the stall timer fires AT the head's deadline on the
+                    # virtual clock; any observed stall materially past
+                    # the budget means detection drifted
+                    failures.append(
+                        "straggler stalled %.0f ms against a %.0f ms"
+                        " budget — disconnect landed outside the window"
+                        % (sb.sendq_max_stall_ms, stall_budget)
+                    )
             for f in spec.faults:
                 checker = getattr(f, "assert_cache_unpolluted", None)
                 if checker is not None:
@@ -333,6 +428,22 @@ class Scenario:
     def _raw(self, idx: int) -> bytes:
         return Simulation._raw_key(self.node_keys[idx])
 
+    def _excluded_raw(self) -> set:
+        return {self._raw(i) for i in self.spec.liveness_exclude}
+
+    def _excluded_prefixes(self) -> set:
+        return {r.hex()[:8] for r in self._excluded_raw()}
+
+    def _liveness_lcls(self) -> List[int]:
+        """LCLs of the liveness-gated nodes (the spec's deliberate
+        straggler, if any, is excluded from the floor it cannot meet)."""
+        excluded = self._excluded_raw()
+        return [
+            app.ledger_manager.get_last_closed_ledger_num()
+            for raw, app in self.sim.nodes.items()
+            if raw not in excluded
+        ]
+
     def _doctor(self, first: bool = False) -> None:
         """Link doctor tick: re-establish flapped/expected links (lossy
         links kill connections via MAC-sequence breaks; restarts rejoin
@@ -348,7 +459,7 @@ class Scenario:
 
     def _target_reached(self) -> bool:
         sim = self.sim
-        lcls = sim.ledger_nums()
+        lcls = self._liveness_lcls()
         if not lcls:
             return False
         # recovery stamp: first moment every surviving node moved past the

@@ -39,6 +39,17 @@ _AGG_KEYS = (
     "fallback_envelopes", "gate_rejects",
 )
 
+# overlay survival plane (overlay/sendqueue.py): per-class send-side
+# sheds + straggler disconnects are crank-deterministic counters (they
+# join the virtual-mode digest); bytes_high_water/max_stall_ms are
+# node-level maxima (taken from the AFTER snapshot, monotone per node)
+# and recv_load_sheds is the LoadManager's receive-side decision count.
+_SENDQ_DELTA_KEYS = (
+    "shed_critical", "shed_fetch", "shed_flood", "shed_gossip",
+    "stragglers", "oversized_admits",
+)
+_SENDQ_MAX_KEYS = ("bytes_high_water", "max_stall_ms")
+
 
 def _node_counters(app) -> Dict[str, int]:
     h = app.herder
@@ -54,7 +65,31 @@ def _node_counters(app) -> Dict[str, int]:
     out.update(
         {"agg." + k: scheme_stats.get(k, 0) for k in _AGG_KEYS}
     )
+    sq = getattr(om, "sendq_stats", None) if om else None
+    if sq is not None:
+        from ..overlay.sendqueue import (
+            CLASS_CRITICAL, CLASS_FETCH, CLASS_FLOOD, CLASS_GOSSIP,
+        )
+
+        out.update({
+            "sendq.shed_critical": sq.shed_msgs[CLASS_CRITICAL],
+            "sendq.shed_fetch": sq.shed_msgs[CLASS_FETCH],
+            "sendq.shed_flood": sq.shed_msgs[CLASS_FLOOD],
+            "sendq.shed_gossip": sq.shed_msgs[CLASS_GOSSIP],
+            "sendq.stragglers": sq.straggler_disconnects,
+            "sendq.oversized_admits": sq.oversized_admits,
+            "sendq.bytes_high_water": sq.bytes_high_water,
+            "sendq.max_stall_ms": sq.max_stall_ms,
+        })
+    else:
+        out.update({"sendq." + k: 0 for k in _SENDQ_DELTA_KEYS})
+        out.update({"sendq." + k: 0 for k in _SENDQ_MAX_KEYS})
     out.update({
+        "recv_load_sheds": (
+            om.load_manager.n_sheds
+            if om and getattr(om, "load_manager", None) is not None
+            else 0
+        ),
         "externalized": h.m_value_externalize.count if h else 0,
         "nomination_rounds": h.n_nomination_rounds if h else 0,
         "ballot_rounds": h.n_ballot_rounds if h else 0,
@@ -114,6 +149,20 @@ class LivenessScoreboard:
     ledgers_agree: bool = True
     final_lcls: Dict[str, int] = field(default_factory=dict)
     final_hash: str = ""  # ledger hash at the lowest common sequence
+    # overlay survival plane (overlay/sendqueue.py): send-side sheds per
+    # class (window deltas; CRITICAL must stay 0 — Scenario.run fails any
+    # run that sheds it), straggler disconnects, and node-level maxima
+    # for queue-byte high-water / observed CRITICAL stall
+    sendq_sheds: Dict[str, int] = field(default_factory=dict)
+    sendq_straggler_disconnects: int = 0
+    # unsheddable frames bigger than the whole cap admitted alone on an
+    # empty queue: while one is queued the documented per-peer bound is
+    # max(cap, that frame), so the high-water verdict must not read a
+    # breach off the raw cap when these occurred
+    sendq_oversized_admits: int = 0
+    sendq_bytes_high_water: int = 0
+    sendq_max_stall_ms: float = 0.0
+    recv_load_sheds: int = 0  # LoadManager (receive-cost) shed decisions
     # close pipeline (reported, excluded from digest: thread timing)
     pipeline: Dict[str, float] = field(default_factory=dict)
     # SCP signature-scheme plane (reported, excluded from digest: wall
@@ -122,7 +171,13 @@ class LivenessScoreboard:
     notes: List[str] = field(default_factory=list)
 
     @classmethod
-    def from_snapshots(cls, sim, before: Snapshot, after: Snapshot, **kw):
+    def from_snapshots(
+        cls, sim, before: Snapshot, after: Snapshot, exclude_nodes=(), **kw
+    ):
+        """``exclude_nodes``: node hex prefixes excluded from the min-LCL
+        liveness computation (a scenario's deliberate straggler must not
+        gate the consensus floor it is designed to miss); every other
+        counter — and chain agreement — still covers them."""
         sb = cls(**kw)
         sb.wall_seconds = max(1e-9, after.at - before.at)
         deltas = []
@@ -142,6 +197,7 @@ class LivenessScoreboard:
         closed = [
             after.lcls[n] - before.lcls.get(n, 0)
             for n in after.lcls
+            if n not in exclude_nodes
         ]
         sb.ledgers_closed = min(closed) if closed else 0
         sb.ledgers_per_sec = round(sb.ledgers_closed / sb.wall_seconds, 3)
@@ -180,6 +236,37 @@ class LivenessScoreboard:
             k: round(sum(d.get("agg." + k, 0) for d in deltas), 1)
             for k in _AGG_KEYS
         }
+        for short, key in (
+            ("critical", "sendq.shed_critical"),
+            ("fetch", "sendq.shed_fetch"),
+            ("flood", "sendq.shed_flood"),
+            ("gossip", "sendq.shed_gossip"),
+        ):
+            sb.sendq_sheds[short] = sum(d.get(key, 0) for d in deltas)
+        sb.sendq_straggler_disconnects = sum(
+            d.get("sendq.stragglers", 0) for d in deltas
+        )
+        sb.sendq_oversized_admits = sum(
+            d.get("sendq.oversized_admits", 0) for d in deltas
+        )
+        # maxima, not deltas: monotone per node, the AFTER snapshot IS
+        # the run's high-water (stabilization traffic never congests)
+        sb.sendq_bytes_high_water = max(
+            (c.get("sendq.bytes_high_water", 0)
+             for c in after.counters.values()),
+            default=0,
+        )
+        sb.sendq_max_stall_ms = round(
+            max(
+                (c.get("sendq.max_stall_ms", 0.0)
+                 for c in after.counters.values()),
+                default=0.0,
+            ),
+            1,
+        )
+        sb.recv_load_sheds = sum(
+            d.get("recv_load_sheds", 0) for d in deltas
+        )
         return sb
 
     def to_dict(self) -> dict:
@@ -211,6 +298,13 @@ class LivenessScoreboard:
                 ballot_rounds=self.ballot_rounds,
                 fast_rejects=self.fast_rejects,
                 recovery_ms=self.recovery_ms,
+                # send-side sheds + stragglers are byte- and crank-
+                # deterministic; the byte high-water is reported but NOT
+                # digested (it depends on per-host frame sizes only
+                # through deterministic packing, but keeping the digest
+                # lean keeps cross-version replays comparable)
+                sendq_sheds=dict(sorted(self.sendq_sheds.items())),
+                sendq_stragglers=self.sendq_straggler_disconnects,
             )
         return sha256(
             json.dumps(stable, sort_keys=True).encode()

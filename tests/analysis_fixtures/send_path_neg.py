@@ -1,0 +1,20 @@
+# analysis-fixture-path: overlay/loopback.py
+# NEGATIVE: the sanctioned shapes — the loopback transport's own drain
+# methods moving frames through out_queue (send_frame receives from the
+# SendQueue's release; deliver_one re-queues fault duplicates), plus
+# queue-shaped code that is NOT the overlay out_queue.  (The other
+# sanctioned site — sendqueue.py's _emit calling peer.send_frame — is
+# excluded by path: the rule never applies to overlay/sendqueue.py.)
+
+
+class FakeLoopback:
+    def send_frame(self, data):
+        self.out_queue.append(data)  # the drain: frames enter the wire
+
+    def deliver_one(self):
+        entry = self.out_queue.popleft()
+        self.out_queue.append((entry, False))  # fault re-queue, sanctioned
+        return True
+
+    def unrelated(self, item):
+        self.work_queue.append(item)  # some other queue entirely

@@ -1,0 +1,16 @@
+# analysis-fixture-path: overlay/rogue_sender_fixture.py
+# POSITIVE: outbound bytes dodging the SendQueue choke point — a direct
+# send_frame() (double-assigns / skips the drain-time MAC sequence and
+# every cap) and out_queue.append() outside the loopback drain methods.
+
+
+def spray(peer, frame):
+    peer.send_frame(frame)  # bypasses caps + priority + straggler plane
+
+
+def spray_self(self, frame):
+    self.send_frame(frame)
+
+
+def stuff_transport(self, data):
+    self.out_queue.append(data)  # not a drain method on this path

@@ -75,6 +75,12 @@ def test_bench_byzantine_flood_leg_direct():
     assert out["strict_gate_rejects_per_sec"] > 0
     assert out["n"] == 64
     assert out["cache_latched_invalid"] == 0
+    # the send-side survival plane leg (ISSUE r17): shed rate + bounded
+    # queue-byte high-water + CRITICAL untouched, on every flood line
+    sq = out["sendq"]
+    assert sq["sendq_shed_per_sec"] > 0
+    assert 0 < sq["sendq_bytes_high_water"] <= sq["cap_bytes"]
+    assert sq["critical_sheds"] == 0
     from stellar_tpu import native
 
     if native.load_sighash() is not None:

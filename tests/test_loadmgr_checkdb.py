@@ -159,6 +159,70 @@ class TestLoadManager:
         lm.maybe_shed_excess_load()
         assert costly.dropped and not cheap.dropped
 
+    def test_idle_fraction_window_gates_the_shed(self, clock):
+        """ISSUE r17 satellite: drive the idle-fraction window across the
+        MINIMUM_IDLE_PERCENT boundary directly — idle above the floor
+        must NOT shed (and resets the window); idle below it sheds
+        exactly the lexicographically-worst-costed peer, counts the
+        decision (``n_sheds`` — the chaos scoreboard's receive-side shed
+        counter, next to the send-side SendQueue sheds) and marks the
+        meter."""
+        import time as _t
+
+        app = make_app(clock, 48)
+        app.config.MINIMUM_IDLE_PERCENT = 40
+
+        class FakePeer:
+            def __init__(self, pid):
+                from stellar_tpu.xdr.entries import PublicKey
+
+                self.peer_id = PublicKey.from_ed25519(pid)
+                self.dropped = False
+
+            def is_authenticated(self):
+                return True
+
+            def drop(self):
+                self.dropped = True
+
+        p1, p2, p3 = (
+            FakePeer(b"\x01" * 32),
+            FakePeer(b"\x02" * 32),
+            FakePeer(b"\x03" * 32),
+        )
+
+        class FakeOverlay:
+            def get_peers(self):
+                return [p1, p2, p3]
+
+        app.overlay_manager = FakeOverlay()
+        lm = LoadManager(app)
+        app.overlay_manager.load_manager = lm
+        # worst by the reference's lexicographic (time, send, recv, sql)
+        lm.get_peer_costs(bytes(p1.peer_id.value)).time_spent = 1.0
+        pc2 = lm.get_peer_costs(bytes(p2.peer_id.value))
+        pc2.time_spent = 1.0
+        pc2.bytes_send = 999  # ties time with p1, loses on bytes_send
+        lm.get_peer_costs(bytes(p3.peer_id.value)).time_spent = 0.2
+
+        # 80% idle over a 10s window (busy 2s): above the 40% floor
+        lm._window_start = _t.monotonic() - 10.0
+        lm._busy_seconds = 2.0
+        lm.maybe_shed_excess_load()
+        assert not (p1.dropped or p2.dropped or p3.dropped)
+        assert lm.n_sheds == 0
+        assert lm._busy_seconds == 0.0  # window reset either way
+
+        # 5% idle over a 10s window (busy 9.5s): below the floor → shed
+        lm._window_start = _t.monotonic() - 10.0
+        lm._busy_seconds = 9.5
+        lm.maybe_shed_excess_load()
+        assert p2.dropped  # the (1.0s, 999B) peer is the lexicographic max
+        assert not p1.dropped and not p3.dropped
+        assert lm.n_sheds == 1
+        assert lm._shed_meter.count == 1
+        assert lm._busy_seconds == 0.0
+
     def test_lru_bounds_table(self, clock):
         app = make_app(clock, 45)
         lm = LoadManager(app)

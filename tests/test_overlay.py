@@ -241,8 +241,64 @@ def test_fetch_timeout_retries(two_apps):
     env.statement.slotIndex = 2
     fetcher.fetch(b"\x09" * 32, env)
     n0 = len(asked)
-    clock.crank_for(5)  # several 1.5s retry timeouts
+    clock.crank_for(5)  # past the first (backed-off) retry deadlines
     assert len(asked) > n0
+
+
+def test_fetch_retry_backoff_and_metered_give_up(two_apps):
+    """ISSUE r17 satellite: the fixed 1.5s retry is now capped
+    exponential backoff (seeded jitter — deterministic), and a tracker
+    that exhausts every peer FETCH_GIVE_UP_ROUNDS full rounds without
+    progress surfaces a METERED give-up instead of spinning forever."""
+    from stellar_tpu.overlay.itemfetcher import (
+        FETCH_BACKOFF_CAP,
+        FETCH_GIVE_UP_ROUNDS,
+        MS_TO_WAIT_FOR_FETCH_REPLY,
+    )
+
+    clock, a, b = two_apps
+    conn = LoopbackPeerConnection(a, b)
+    crank(clock)
+    # the backoff ladder spans minutes of virtual silence; keep the
+    # otherwise-idle link from tripping the 30s idle drop mid-ladder
+    conn.initiator.io_timeout_seconds = lambda: 10**6
+    conn.acceptor.io_timeout_seconds = lambda: 10**6
+
+    ask_times = []
+    fetcher = a.overlay_manager.qset_fetcher
+    fetcher.ask_peer = lambda p, h: ask_times.append(clock.now())
+    from stellar_tpu.xdr.scp import SCPEnvelope, SCPStatement
+
+    env = SCPEnvelope()
+    env.statement = SCPStatement()
+    env.statement.slotIndex = 2
+    h = b"\x0b" * 32
+    fetcher.fetch(h, env)
+    tracker = fetcher.trackers[h]
+    # nobody ever answers: crank far enough for every round + backoff
+    clock.crank_for(60 * FETCH_GIVE_UP_ROUNDS)
+    assert tracker.gave_up
+    assert len(fetcher) == 0  # the fetcher forgot the tracker
+    # one ask per no-progress round (single-peer topology), then stop
+    assert len(ask_times) == FETCH_GIVE_UP_ROUNDS
+    gaps = [t1 - t0 for t0, t1 in zip(ask_times, ask_times[1:])]
+    # intervals grow (exponential w/ jitter) and respect the cap
+    assert gaps[1] > gaps[0]
+    assert all(g <= FETCH_BACKOFF_CAP * 1.25 + 1e-6 for g in gaps)
+    assert gaps[0] >= MS_TO_WAIT_FOR_FETCH_REPLY
+    give_ups = a.metrics.new_meter(("overlay", "fetch", "give-up"), "fetch")
+    assert give_ups.count == 1
+    # jitter is seeded from the item hash: two fresh trackers for the
+    # same item roll the same backoff sequence (determinism rule)
+    from stellar_tpu.overlay.itemfetcher import Tracker
+
+    t2 = Tracker(a, h, lambda p, hh: None)
+    t3 = Tracker(a, h, lambda p, hh: None)
+    assert [t2._retry_delay() for _ in range(4)] == [
+        t3._retry_delay() for _ in range(4)
+    ]
+    t2.finish("test")
+    t3.finish("test")
 
 
 # -- TCP transport ---------------------------------------------------------
