@@ -1264,6 +1264,19 @@ def _close_in_subprocess(n_txs: int, n_ledgers: int, timeout: float) -> dict:
     }
 
 
+def _measure_selfcheck_ms(app) -> float:
+    """One boot self-check pass (main/selfcheck.py) against the bench
+    node's end-of-run state: the cost a restart would pay before its
+    ledger loads.  Verify-only (repair=False): same checks, but a cost
+    probe on a LIVE app must never mutate its durable state."""
+    from stellar_tpu.main.selfcheck import run_boot_selfcheck
+
+    try:
+        return float(run_boot_selfcheck(app, repair=False)["duration_ms"])
+    except Exception:
+        return -1.0  # never let the diagnostic leg kill the close line
+
+
 def bench_ledger_close(n_txs=5000, n_ledgers=3):
     """p50/p95 wall time to validate + close a ledger carrying an
     ``n_txs``-transaction TxSet of single-sig payments (BASELINE.md's
@@ -1549,6 +1562,11 @@ def bench_ledger_close(n_txs=5000, n_ledgers=3):
             "device_hash": app.sig_backend.stats().get(
                 "device_hash", False
             ),
+            # boot self-check cost (ISSUE r18): what a restart of THIS
+            # node's state pays in main/selfcheck.py before the ledger
+            # loads (bucket re-hash dominates) — a boot-cost regression
+            # shows up here without waiting for a real restart
+            "selfcheck_ms": _measure_selfcheck_ms(app),
         }
     finally:
         app.graceful_stop()

@@ -171,6 +171,19 @@ FINAL_STEPS = [
      [sys.executable, "-u", "-m", "stellar_tpu.scenarios",
       "--only", "slow_reader,overload_storm", "--json"],
      900),
+    # r18: crash-and-corruption survival plane — the full kill-sweep
+    # (scenarios/killsweep.py): one subprocess hard-kill (os._exit, plus
+    # truncated/torn-file modes at the :write stages) at EVERY
+    # registered durable-write kill-point a close+publish window
+    # crosses, each restart asserting the boot self-check repairs to
+    # LCL/bucket/SQL state bit-exact vs an unkilled control.  Exits 1
+    # on any unrecovered point, missed kill, or hash mismatch —
+    # relay-independent, re-certified each green window so the storage
+    # plane can't silently regress.
+    ("crash_sweep_r18",
+     [sys.executable, "-u", "-m", "stellar_tpu.scenarios",
+      "--kill-sweep", "--json"],
+     1200),
 ]
 ALL_NAMES = (
     [s[0] for s in SCRIPT_STEPS]

@@ -456,6 +456,78 @@ class SendPathRule(Rule):
 
 
 @register
+class DurableWriteRule(Rule):
+    """The crash-survival contract (r18): durable artifacts — bucket
+    files, history staging, persisted state files — reach disk ONLY
+    through util/fs.py's write-tmp → fsync → rename → fsync-dir helpers
+    (or the durable XDROutputFileStream), which also carry the named
+    storage kill-points the kill-sweep proves recovery against.  A bare
+    ``open(path, "w"/"wb"/"a")`` or raw ``os.rename``/``os.replace`` in
+    the durable-artifact packages (bucket/, history/, main/) writes a
+    file a kill can tear with no fault-injection coverage and no
+    fsync/atomic-rename discipline — exactly the class of hole the boot
+    self-check exists to repair."""
+
+    id = "durable-write"
+    doc = (
+        "bare open(.., 'w*'/'a*') or os.rename/os.replace on a durable"
+        " artifact (bucket/, history/, main/) — route through util/fs.py"
+        " so the write is crash-safe and kill-point covered"
+    )
+
+    SCOPED = ("bucket/", "history/", "main/")
+    WRITE_MODES_PREFIX = ("w", "a", "x")
+    RENAMES = {"rename", "replace"}
+
+    def applies(self, ctx: FileContext) -> bool:
+        return ctx.relpath.startswith(self.SCOPED)
+
+    @staticmethod
+    def _mode_of(node: ast.Call):
+        """The mode literal of an open() call, or None when absent or
+        dynamic (dynamic modes are flagged conservatively by returning
+        the sentinel '?')."""
+        mode = None
+        if len(node.args) >= 2:
+            mode = node.args[1]
+        for kw in node.keywords:
+            if kw.arg == "mode":
+                mode = kw.value
+        if mode is None:
+            return None  # default 'r'
+        if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+            return mode.value
+        return "?"
+
+    def check(self, ctx: FileContext) -> Iterator[Hit]:
+        for node in _walk(ctx):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Name) and f.id == "open":
+                mode = self._mode_of(node)
+                if mode is None:
+                    continue  # read mode
+                if mode == "?" or mode.startswith(self.WRITE_MODES_PREFIX):
+                    yield (
+                        node.lineno,
+                        f"bare open(..., {mode!r}) writes a durable"
+                        " artifact with no fsync/rename discipline and"
+                        " no kill-point — use fs.durable_write/"
+                        "stage_write (or a durable XDROutputFileStream)",
+                    )
+            elif isinstance(f, ast.Attribute) and f.attr in self.RENAMES:
+                chain = attr_chain(f)
+                if chain and chain[0] == "os":
+                    yield (
+                        node.lineno,
+                        f"raw os.{f.attr}() places a durable artifact"
+                        " without fsync(file)+fsync(dir) or a kill-point"
+                        " — use fs.durable_rename",
+                    )
+
+
+@register
 class MetricsFastLaneRule(Rule):
     """The PR 3 metrics fast lane keeps a close-path record at one tuple +
     deque append; registry-built metrics (``app.metrics.new_*``) ride it.

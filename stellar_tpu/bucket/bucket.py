@@ -21,12 +21,28 @@ from typing import Iterable, Iterator, List, Optional, Tuple
 
 from ..crypto import SHA256
 from ..ledger.entryframe import ledger_key_of, store_add_or_change, store_delete_key
+from ..util import fs
 from ..util.xdrstream import XDRInputFileStream, XDROutputFileStream
 from ..xdr.base import pack_many
 from ..xdr.entries import LedgerEntry
 from ..xdr.ledger import BucketEntry, BucketEntryType, LedgerKey
 
 ZERO_HASH = b"\x00" * 32
+
+# storage kill-points (util/fs.py): every durable bucket write is a
+# named fault-injection site for the kill-sweep / hard-kill chaos plane
+KP_FRESH = fs.register_durable_site(
+    "bucket.fresh", stages=(fs.STAGE_WRITE, fs.STAGE_STAGED),
+    doc="one ledger's fresh batch packed+staged as a tmp bucket file",
+)
+KP_MERGE = fs.register_durable_site(
+    "bucket.merge", stages=(fs.STAGE_WRITE, fs.STAGE_STAGED),
+    doc="python streaming merge writing the level-spill tmp bucket",
+)
+KP_NATIVE_MERGE = fs.register_durable_site(
+    "bucket.native-merge", stages=(fs.STAGE_STAGED,),
+    doc="C merge engine output fsynced before adoption",
+)
 
 
 def entry_identity(e: BucketEntry) -> Tuple[int, bytes]:
@@ -165,8 +181,12 @@ class Bucket:
         )
         hasher = SHA256()
         hasher.add(data)
-        with open(tmp, "wb") as f:
-            f.write(data)
+        # crash-safe staging (util/fs.py): write + fsync before adoption
+        # renames it to the content-addressed home — a kill at any point
+        # leaves either a reapable tmp or the complete file
+        fs.stage_write(
+            tmp, data, point=KP_FRESH, ctx=bucket_manager.app.database
+        )
         return bucket_manager.adopt_file_as_bucket(
             tmp, hasher.finish(), len(merged)
         )
@@ -242,6 +262,12 @@ def _try_native_merge(
     Returns the merged Bucket, or None to fall back to Python."""
     from .. import native
 
+    # test/chaos knob: the kill-sweep drives the Python merge leg's
+    # kill-points through here (output is bit-identical either way,
+    # pinned by tests/test_native_merge.py)
+    if os.environ.get("STELLAR_TPU_NO_NATIVE_MERGE"):
+        return None
+
     def path_of(b):
         if b.is_empty():
             return ""
@@ -261,6 +287,13 @@ def _try_native_merge(
         if os.path.exists(tmp):
             os.unlink(tmp)
         return Bucket()
+    # the C engine wrote with plain stdio: fsync before adoption renames
+    # it into the content-addressed namespace (util/fs.py discipline)
+    fs.fsync_path(tmp)
+    fs.kill_point(
+        KP_NATIVE_MERGE + fs.STAGE_STAGED, path=tmp,
+        ctx=bucket_manager.app.database,
+    )
     return bucket_manager.adopt_file_as_bucket(tmp, h, count)
 
 
@@ -279,7 +312,10 @@ def _write_merged(
     oi = _Peekable(old_it)
     ni = _Peekable(new_it)
     buffered = None  # (identity, entry): one-entry dedup window
-    with XDROutputFileStream(tmp, hasher=hasher) as out:
+    with XDROutputFileStream(
+        tmp, hasher=hasher, durable=True, point=KP_MERGE,
+        ctx=bucket_manager.app.database,
+    ) as out:
 
         def put(e: BucketEntry, identity) -> None:
             """Buffer one entry so adjacent same-identity entries collapse

@@ -18,11 +18,17 @@ import os
 import threading
 from typing import Dict, List, Optional
 
-from ..util import xlog
+from ..util import fs, xlog
 from .bucket import ZERO_HASH, Bucket
 from .bucketlist import BucketList
 
 log = xlog.logger("Bucket")
+
+# adoption is the rename half of every bucket write's durability story
+KP_ADOPT = fs.register_durable_site(
+    "bucket.adopt", stages=(fs.STAGE_STAGED, fs.STAGE_RENAMED),
+    doc="staged bucket renamed to its content-addressed canonical name",
+)
 
 
 class BucketManager:
@@ -37,12 +43,17 @@ class BucketManager:
         # construction, and buckets must survive restart (merge resume).
         self.bucket_dir = os.path.abspath(app.config.BUCKET_DIR_PATH)
         os.makedirs(self.bucket_dir, exist_ok=True)
-        # sweep merge temp files orphaned by a crash (the dir is persistent
-        # by design, so nothing else cleans them)
+        # sweep merge temp files (and boot-quarantined corpses) orphaned
+        # by a crash — the dir is persistent by design, so nothing else
+        # cleans them.  Counted so the boot self-check can meter it.
+        self.tmp_swept_at_boot = 0
         for name in os.listdir(self.bucket_dir):
-            if name.startswith("tmp-bucket-"):
+            if name.startswith((".durable-", "tmp-bucket-")) or (
+                ".quarantined" in name
+            ):
                 try:
                     os.unlink(os.path.join(self.bucket_dir, name))
+                    self.tmp_swept_at_boot += 1
                 except OSError:
                     pass
 
@@ -61,7 +72,14 @@ class BucketManager:
                 os.unlink(path)
                 return existing
             canonical = self.bucket_filename(h)
-            os.replace(path, canonical)
+            # every producer stages through the fs discipline (fresh /
+            # _write_merged sync on close, the native merge fsyncs
+            # explicitly), so the file is already durable — skip the
+            # redundant per-adoption fsync
+            fs.durable_rename(
+                path, canonical, point=KP_ADOPT, ctx=self.app.database,
+                presynced=True,
+            )
             b = Bucket(canonical, h, objects)
             self._buckets[h] = b
             return b
@@ -102,6 +120,61 @@ class BucketManager:
                 seen.add(h)
                 missing.append(h)
         return missing
+
+    # -- on-disk integrity (boot self-check, stellar_tpu/main/selfcheck.py) -
+    def verify_bucket_file(self, h: bytes) -> str:
+        """One referenced bucket file's on-disk state: ``"ok"``,
+        ``"missing"``, or ``"corrupt"`` (zero-length, truncated, or any
+        content whose SHA256 is not the name — the hash IS the file's
+        identity, so a full re-hash is the only honest check)."""
+        if h == ZERO_HASH:
+            return "ok"
+        path = self.bucket_filename(h)
+        if not os.path.exists(path):
+            return "missing"
+        if os.path.getsize(path) == 0:
+            return "corrupt"
+        from ..crypto import SHA256
+
+        hasher = SHA256()
+        with open(path, "rb") as f:
+            while True:
+                chunk = f.read(1 << 20)
+                if not chunk:
+                    break
+                hasher.add(chunk)
+        return "ok" if hasher.finish() == h else "corrupt"
+
+    def verify_bucket_files(self, *states) -> dict:
+        """Every hash the given HistoryArchiveState(s) reference,
+        classified (deduplicated across states) — the integrity
+        extension of ``check_for_missing_bucket_files``.  The boot
+        self-check feeds the persisted HAS plus every queued-checkpoint
+        state through here (main/selfcheck.py)."""
+        out = {"ok": [], "missing": [], "corrupt": []}
+        seen = set()
+        for has in states:
+            for h in has.all_bucket_hashes():
+                if h == ZERO_HASH or h in seen:
+                    continue
+                seen.add(h)
+                out[self.verify_bucket_file(h)].append(h)
+        return out
+
+    def quarantine_bucket_file(self, h: bytes) -> None:
+        """Move a failed-verification file out of the content-addressed
+        namespace so every downstream path (has_bucket, the boot repair's
+        missing-file scan, catchup) treats it as MISSING rather than
+        trusting corrupt bytes.  The corpse keeps its data for forensics
+        until the next boot's tmp sweep reaps it."""
+        path = self.bucket_filename(h)
+        try:
+            # analysis: off durable-write -- quarantine moves already-CORRUPT bytes out of the namespace; fsync discipline buys nothing (a crash mid-move just re-quarantines at the next boot — idempotent)
+            os.replace(path, path + ".quarantined")
+        except OSError:
+            pass
+        with self._lock:
+            self._buckets.pop(h, None)
 
     # -- ledger-close interface (LedgerManager calls these) ----------------
     def add_batch(self, ledger_seq: int, live_entries, dead_entries) -> None:

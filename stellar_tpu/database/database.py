@@ -19,9 +19,21 @@ import time
 from contextlib import contextmanager
 from typing import Any, Iterable, List, Optional, Tuple
 
+from ..util import fs
 from .dialect import dialect_for
 
 SCHEMA_VERSION = 1
+
+# the outermost COMMIT is THE durable boundary of the SQL plane: a kill
+# on the :pre side loses the whole transaction (restart sees the prior
+# state), on the :post side the transaction survives (restart resumes
+# from it) — both ends are registered storage kill-points
+KP_COMMIT_PRE = fs.register_kill_point(
+    "db.commit:pre", "outermost SQL transaction about to COMMIT"
+)
+KP_COMMIT_POST = fs.register_kill_point(
+    "db.commit:post", "outermost SQL COMMIT durable, post-commit work not run"
+)
 
 
 class UnrollbackableWrite(RuntimeError):
@@ -154,7 +166,9 @@ class Database:
                 raise
             else:
                 self._tx_depth -= 1
+                fs.kill_point(KP_COMMIT_PRE, ctx=self)
                 self._conn.execute("COMMIT")
+                fs.kill_point(KP_COMMIT_POST, ctx=self)
         else:
             # the write-back entry store buffer (ledger/storebuffer.py)
             # mirrors the savepoint stack: buffered entry writes unwind in

@@ -24,7 +24,7 @@ from ..crypto import PubKeyUtils, sha256
 from ..scp import SCP, SCPDriver
 from ..scp.quorum import qset_hash as compute_qset_hash
 from ..scp.slot import Slot
-from ..util import VirtualTimer, xlog
+from ..util import VirtualTimer, fs, xlog
 from ..xdr.base import xdr_getfield, xdr_to_opaque
 from ..xdr.entries import EnvelopeType
 from ..xdr.ledger import (
@@ -50,6 +50,15 @@ MAX_TIME_SLIP_SECONDS = 60
 NODE_EXPIRATION_SECONDS = 240
 LEDGER_VALIDITY_BRACKET = 1000
 MAX_SLOTS_TO_REMEMBER = 4
+
+# storage kill-points (util/fs.py): the SCP-state persist is the boot
+# reconciliation's third leg next to the header chain + publish queue
+KP_SCP_PERSIST_PRE = fs.register_kill_point(
+    "scp.persist:pre", "lastscpdata row about to be written"
+)
+KP_SCP_PERSIST_POST = fs.register_kill_point(
+    "scp.persist:post", "lastscpdata row written (autocommit durable)"
+)
 
 # TransactionSubmitStatus (herder/Herder.h)
 TX_STATUS_PENDING = "PENDING"
@@ -965,9 +974,11 @@ class Herder(SCPDriver):
             + pack_var_array_of(TransactionSet, [t.to_xdr() for t in txsets.values()])
             + pack_var_array_of(SCPQuorumSet, list(qsets.values()))
         )
+        fs.kill_point(KP_SCP_PERSIST_PRE, ctx=self.app.database)
         self.app.persistent_state.set_state(
             K_LAST_SCP_DATA, base64.b64encode(blob).decode()
         )
+        fs.kill_point(KP_SCP_PERSIST_POST, ctx=self.app.database)
 
     def restore_scp_state(self) -> None:
         import base64
@@ -994,6 +1005,47 @@ class Herder(SCPDriver):
             self.scp.set_state_from_envelope(e.statement.slotIndex, e)
         if envs:
             self._start_rebroadcast_timer()
+        self._replay_interrupted_close(envs)
+
+    def _replay_interrupted_close(self, envs) -> None:
+        """Finish a close the previous life died inside (the crash-
+        survival plane, ISSUE r18).  A node killed between SCP
+        externalize and the close's SQL COMMIT restarts with LCL = n-1
+        while its restored slot-n state is already in EXTERNALIZE phase
+        — set_state_from_envelope never re-fires value_externalized, so
+        without this the node can neither close n itself nor (its vote
+        being gated on sync) help a 3-of-3 quorum move past n+1.  The
+        decision for slot n is final (quorum externalized it; our own
+        restored statement proves we saw that quorum), so re-driving
+        the close from the persisted value + txset is deterministic
+        replay, not re-deciding — the kill-sweep pins the resulting
+        hashes bit-exact against an unkilled control."""
+        from ..xdr.scp import SCPStatementType
+
+        lcl = self.ledger_manager.get_last_closed_ledger_num()
+        for e in envs:
+            st = e.statement
+            if (
+                st.pledges.type != SCPStatementType.SCP_ST_EXTERNALIZE
+                or st.slotIndex != lcl + 1
+            ):
+                continue
+            try:
+                sv = StellarValue.from_xdr(st.pledges.value.commit.value)
+            except Exception:
+                continue  # value undecodable: leave it to catchup
+            ts = self.pending_envelopes.get_tx_set(sv.txSetHash)
+            if ts is None:
+                continue  # txset not persisted: leave it to catchup
+            log.info(
+                "replaying interrupted close of ledger %d from restored"
+                " SCP state",
+                st.slotIndex,
+            )
+            self.ledger_manager.externalize_value(
+                LedgerCloseData(st.slotIndex, ts, sv)
+            )
+            return
 
     # ------------------------------------------------------------------
     # misc

@@ -7,6 +7,15 @@ from __future__ import annotations
 
 from typing import List
 
+from ..util import fs
+
+# the queue row is written INSIDE the ledger-close transaction; a kill
+# here must repair to "checkpoint still queued" (or "close never
+# happened") on restart — never to a lost checkpoint
+KP_QUEUE_ROW = fs.register_kill_point(
+    "publish.queue-row", "crash-safe publishqueue row written in the close txn"
+)
+
 
 def drop_publish_queue(db) -> None:
     db.execute("DROP TABLE IF EXISTS publishqueue")
@@ -23,6 +32,7 @@ def queue_checkpoint(db, ledger_seq: int, state_json: str) -> None:
         "INSERT OR REPLACE INTO publishqueue (ledger, state) VALUES (?,?)",
         (ledger_seq, state_json),
     )
+    fs.kill_point(KP_QUEUE_ROW, ctx=db)
 
 
 def queued_checkpoints(db) -> List[tuple]:

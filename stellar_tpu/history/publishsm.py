@@ -24,7 +24,7 @@ import os
 import shutil
 from typing import Callable, List, Optional
 
-from ..util import xlog
+from ..util import fs, xlog
 from ..xdr.ledger import (
     LedgerHeaderHistoryEntry,
     TransactionHistoryEntry,
@@ -41,6 +41,24 @@ from .filetransfer import (
 )
 
 log = xlog.logger("History")
+
+# publish staging kill-points: everything under the publish tmp dir is
+# reconstructible (the queue row survives in SQL), so a kill anywhere
+# here must repair to "staging reaped at boot, checkpoint republished"
+KP_SNAPSHOT = {
+    cat: fs.register_durable_site(
+        f"publish.snapshot.{cat}", stages=(fs.STAGE_WRITE, fs.STAGE_STAGED),
+        doc=f"checkpoint {cat} XDR stream staged for publish",
+    )
+    for cat in (CAT_LEDGER, CAT_TRANSACTIONS, CAT_RESULTS)
+}
+KP_STAGE_BUCKET = fs.register_kill_point(
+    "publish.stage-bucket", "bucket hard-linked/copied into publish staging"
+)
+KP_COMMIT_JSON = fs.register_durable_site(
+    "publish.commit-json",
+    doc="per-archive checkpoint state JSON written for commit",
+)
 
 
 def write_checkpoint_snapshot(app, checkpoint_ledger: int, out_dir: str) -> List[FileTransferInfo]:
@@ -60,9 +78,17 @@ def write_checkpoint_snapshot(app, checkpoint_ledger: int, out_dir: str) -> List
     )
     f_results = FileTransferInfo.for_checkpoint(out_dir, CAT_RESULTS, checkpoint_ledger)
 
-    with XDROutputFileStream(f_ledger.local_path) as lo, XDROutputFileStream(
-        f_txs.local_path
-    ) as to, XDROutputFileStream(f_results.local_path) as ro:
+    db = app.database
+    with XDROutputFileStream(
+        f_ledger.local_path, durable=True,
+        point=KP_SNAPSHOT[CAT_LEDGER], ctx=db,
+    ) as lo, XDROutputFileStream(
+        f_txs.local_path, durable=True,
+        point=KP_SNAPSHOT[CAT_TRANSACTIONS], ctx=db,
+    ) as to, XDROutputFileStream(
+        f_results.local_path, durable=True,
+        point=KP_SNAPSHOT[CAT_RESULTS], ctx=db,
+    ) as ro:
         for frame in LedgerHeaderFrame.load_range(
             app.database, first, checkpoint_ledger
         ):
@@ -109,6 +135,9 @@ def stage_bucket_files(app, has: HistoryArchiveState, out_dir: str) -> List[File
                 os.link(src, fi.local_path)
             except OSError:
                 shutil.copyfile(src, fi.local_path)
+            fs.kill_point(
+                KP_STAGE_BUCKET, path=fi.local_path, ctx=app.database
+            )
         files.append(fi)
     return files
 
@@ -279,8 +308,10 @@ class _ArchivePublisher:
         local = os.path.join(
             self.run.tmp.get_name(), f"commit-{self.archive.name}.json"
         )
-        with open(local, "w") as f:
-            f.write(self.run.state_json)
+        fs.durable_write(
+            local, self.run.state_json, point=KP_COMMIT_JSON,
+            ctx=self.app.database,
+        )
         cp_remote = remote_checkpoint_name("history", self.run.seq, ".json")
 
         def after_cp(rc):

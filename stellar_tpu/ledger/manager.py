@@ -12,7 +12,7 @@ from typing import Callable, Dict, List, Optional
 
 from ..crypto import sha256
 from ..crypto.keys import SecretKey
-from ..util import xlog
+from ..util import fs, xlog
 from ..xdr.base import xdr_copy, XdrError
 from ..xdr.ledger import (
     LedgerHeader,
@@ -30,6 +30,22 @@ from .headerframe import LedgerHeaderFrame
 log = xlog.logger("Ledger")
 
 GENESIS_BALANCE = 1000000000000000000  # 10^18 stroops
+
+# close-path storage kill-points (util/fs.py): the in-transaction ones
+# must repair to "the close never happened" on restart, the post-commit
+# one to "the close fully happened, post-close kicks rerun at boot"
+KP_CLOSE_HEADER = fs.register_kill_point(
+    "close.header-stored", "header row written inside the close txn"
+)
+KP_CLOSE_LCL = fs.register_kill_point(
+    "close.lcl-state", "lastclosedledger/HAS state rows written in-txn"
+)
+KP_CLOSE_PRE = fs.register_kill_point(
+    "close.pre-commit", "whole close applied, enclosing COMMIT not yet run"
+)
+KP_CLOSE_POST = fs.register_kill_point(
+    "close.post-commit", "close committed, publish kick + bucket GC not run"
+)
 
 
 class LedgerState(enum.Enum):
@@ -535,6 +551,8 @@ class LedgerManager:
 
             # queue any checkpoint inside this SQL transaction (crash-safe)
             self.app.history_manager.maybe_queue_history_checkpoint()
+            fs.kill_point(KP_CLOSE_PRE, ctx=self.database)
+        fs.kill_point(KP_CLOSE_POST, ctx=self.database)
         tracer.end(
             commit_sp,
             live=len(ledger_delta.get_live_entries()),
@@ -611,6 +629,7 @@ class LedgerManager:
         self.app.bucket_manager.snapshot_ledger(self.current.header)
         self.current.invalidate_hash()
         self.current.store_insert(self.database)
+        fs.kill_point(KP_CLOSE_HEADER, ctx=self.database)
         ps = PersistentState(self.database)
         ps.set_state(K_LAST_CLOSED_LEDGER, self.current.get_hash().hex())
         ps.set_state(
@@ -618,6 +637,7 @@ class LedgerManager:
                 self.current.header.ledgerSeq
             )
         )
+        fs.kill_point(KP_CLOSE_LCL, ctx=self.database)
         self._advance_ledger_pointers()
 
     def _advance_ledger_pointers(self) -> None:

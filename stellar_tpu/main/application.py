@@ -109,6 +109,8 @@ class Application:
         self.overlay_manager = None
         self.command_handler = None
         self.process_manager = None
+        # boot self-check report (main/selfcheck.py), served on /selfcheck
+        self.last_selfcheck: Optional[dict] = None
 
         if new_db or (auto_init and self._needs_initialization()):
             # offline utility modes (--info/--loadxdr) pass auto_init=False:
@@ -159,6 +161,15 @@ class Application:
                     " a member"
                 )
         if self.persistent_state.get_state(K_DATABASE_INITIALIZED) == "true":
+            # crash-and-corruption survival: verify + repair the durable
+            # state (tmp reap accounting, publish queue, SCP state,
+            # header chain, bucket file hashes) BEFORE anything loads or
+            # trusts it — quarantined buckets become "missing" so the
+            # archive repair below re-fetches them (main/selfcheck.py)
+            if self.config.SELFCHECK_ON_BOOT:
+                from .selfcheck import run_boot_selfcheck
+
+                self.last_selfcheck = run_boot_selfcheck(self)
             if self.ledger_manager.last_closed is None:
                 self.ledger_manager.load_last_known_ledger()
             # drain any checkpoints queued before a crash/restart — the

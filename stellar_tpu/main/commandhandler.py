@@ -54,6 +54,7 @@ class CommandHandler:
             "profiler": self.handle_profiler,
             "trace": self.handle_trace,
             "invariants": self.handle_invariants,
+            "selfcheck": self.handle_selfcheck,
         }
 
     # -- server plumbing ----------------------------------------------------
@@ -484,6 +485,22 @@ class CommandHandler:
         violation, and p50/p95 cost — the operator's view of the close's
         always-on safety checks."""
         return self.app.invariants.dump_info()
+
+    def handle_selfcheck(self, q: dict) -> dict:
+        """The boot self-check & repair report (main/selfcheck.py):
+        what the crash-survival pass verified, quarantined, and repaired
+        before this node's ledger loaded.  ``?rerun=1`` runs a fresh
+        VERIFY-ONLY pass now — damage is reported in ``problems``, never
+        repaired live (boot-only repairs like bucket quarantine depend
+        on the boot-time re-download path)."""
+        if q.get("rerun"):
+            from .selfcheck import run_boot_selfcheck
+
+            return run_boot_selfcheck(self.app, repair=False)
+        return self.app.last_selfcheck or {
+            "status": "not-run",
+            "detail": "node booted with a fresh DB or SELFCHECK_ON_BOOT off",
+        }
 
     def handle_generateload(self, q: dict) -> dict:
         from ..simulation.loadgen import LoadGenerator

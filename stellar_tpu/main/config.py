@@ -295,6 +295,14 @@ class Config:
         # how many upcoming txsets may hold an in-flight prewarm future at
         # once (the lookahead window; 1 = classic two-stage pipeline)
         self.CLOSE_PIPELINE_DEPTH = 2
+        # TPU-native addition: boot self-check & repair
+        # (main/selfcheck.py) — verify every durable artifact (bucket
+        # file hashes, header chain, persisted SCP state, publish queue)
+        # before the ledger loads, quarantining/repairing torn state a
+        # killed process left behind.  The crash-survival contract
+        # (`python -m stellar_tpu.scenarios --kill-sweep`) depends on
+        # it; off is for harnesses that rebuild state wholesale.
+        self.SELFCHECK_ON_BOOT = True
 
     # -- loading -----------------------------------------------------------
     @classmethod
@@ -432,6 +440,14 @@ class Config:
             raise ValueError(
                 f"INVARIANT_CACHE_SAMPLE must be an int >= 1, "
                 f"got {self.INVARIANT_CACHE_SAMPLE!r}"
+            )
+        if not (
+            isinstance(self.SELFCHECK_ON_BOOT, bool)
+            or self.SELFCHECK_ON_BOOT in (0, 1)
+        ):
+            raise ValueError(
+                f"SELFCHECK_ON_BOOT must be a boolean, "
+                f"got {self.SELFCHECK_ON_BOOT!r}"
             )
         if not (
             isinstance(self.CLOSE_PIPELINE_DEPTH, int)

@@ -7,6 +7,17 @@ One line per scenario; exits nonzero when ANY scenario fails — invariant
 violation, chain disagreement, liveness-floor miss, unrecovered heal, or
 a polluted verify cache under flood.  This is the relay_watch
 ``scenario_liveness_r12`` step's entry point.
+
+The storage plane's sweep (relay_watch ``crash_sweep_r18``):
+
+    python -m stellar_tpu.scenarios --kill-sweep [--points P[,P]]
+                                    [--modes exit|all] [--target N] [--json]
+
+hard-kills a standalone node at every registered durable-write
+kill-point it crosses in a close+publish window (one subprocess per
+point × fault mode; scenarios/killsweep.py) and exits 1 on ANY
+unrecovered point or post-repair hash mismatch.  ``--kill-child`` is
+the internal per-leg entry point those subprocesses run.
 """
 
 from __future__ import annotations
@@ -27,7 +38,67 @@ def main(argv=None) -> int:
     )
     ap.add_argument("--seed", type=int, default=1)
     ap.add_argument("--json", action="store_true", dest="as_json")
+    # kill-sweep mode (scenarios/killsweep.py)
+    ap.add_argument("--kill-sweep", action="store_true", dest="kill_sweep")
+    ap.add_argument("--points", help="comma-separated kill-point names")
+    ap.add_argument("--modes", choices=("exit", "all"), default="all")
+    ap.add_argument("--target", type=int, default=None)
+    ap.add_argument("--keep", action="store_true")
+    # internal: one sweep leg (the subprocess the sweep spawns)
+    ap.add_argument("--kill-child", action="store_true", dest="kill_child")
+    ap.add_argument("--workdir")
+    ap.add_argument("--out")
     args = ap.parse_args(argv)
+
+    if args.kill_child:
+        from .killsweep import DEFAULT_TARGET, child_main
+
+        return child_main(
+            args.workdir, args.target or DEFAULT_TARGET, args.out
+        )
+    if args.kill_sweep:
+        from .killsweep import DEFAULT_TARGET, run_kill_sweep
+
+        points = args.points.split(",") if args.points else None
+        if points:
+            from ..util import fs
+            from .killsweep import ensure_points_registered
+
+            ensure_points_registered()
+            unknown = [
+                p for p in points if p not in fs.registered_kill_points()
+            ]
+            if unknown:
+                print(
+                    "unknown kill point(s): %s" % ",".join(unknown),
+                    file=sys.stderr,
+                )
+                return 2
+        report = run_kill_sweep(
+            points=points,
+            all_modes=args.modes == "all",
+            target=args.target or DEFAULT_TARGET,
+            keep=args.keep,
+            log=lambda s: None if args.as_json else print(s),
+        )
+        if args.as_json:
+            print(json.dumps(report, sort_keys=True))
+        else:
+            if report.get("error"):
+                print("kill-sweep ERROR: %s" % report["error"])
+            print(
+                "kill-sweep: %d/%d point×mode legs recovered bit-exact"
+                " (%d distinct points killed; window crosses %d of %d"
+                " registered)"
+                % (
+                    report.get("recovered", 0),
+                    report.get("swept", 0),
+                    len(report.get("points_swept", [])),
+                    len(report.get("points_hit", [])),
+                    report.get("points_registered", 0),
+                )
+            )
+        return 0 if report.get("ok") else 1
 
     only = args.only.split(",") if args.only else None
     if only:

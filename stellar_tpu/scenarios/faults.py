@@ -170,6 +170,102 @@ class CrashRestart(Fault):
 
 
 @dataclass
+class HardKillMidClose(Fault):
+    """The storage plane's chaos class (ISSUE r18): a REAL kill, not
+    ``graceful_stop``.  At ``at`` an in-process storage-fault injector
+    (scenarios/storagefaults.py) arms on the target node's Database; the
+    next time that node crosses the named durable-write kill-point —
+    ``close.pre-commit`` by default: the whole close applied, bucket
+    files written/renamed, header + LCL + publish-queue rows staged,
+    COMMIT not yet run — a ``SimulatedProcessKill`` unwinds the node's
+    entire in-flight close (the SQL transaction rolls back through the
+    context managers, exactly what a restart observes) and
+    ``Simulation.kill_node`` reaps it with NO graceful shutdown.  At
+    ``restart_at`` the node comes back on its on-disk state; the boot
+    self-check (main/selfcheck.py) must report ok/repaired before it
+    rejoins.  Deterministic: (point, nth, owner) under the virtual
+    clock's crank order — the class passes two-run replay."""
+
+    at: float
+    restart_at: float
+    node: int
+    point: str = "close.pre-commit"
+    nth: int = 1
+
+    def __post_init__(self):
+        self.n_kills = 0
+        self.selfcheck = None
+        self._inj = None
+
+    def arm(self, scn) -> None:
+        from ..util import fs
+        from .storagefaults import StorageFaultInjector
+
+        key = scn.node_keys[self.node]
+
+        def arm_injector():
+            app = scn.sim.nodes.get(scn.sim._raw_key(key))
+            if app is None:
+                return
+            inj = StorageFaultInjector(
+                self.point, nth=self.nth, mode="raise",
+                owner=app.database,
+            )
+            self._inj = inj
+            fs.add_kill_hook(inj)
+            scn.note(
+                "armed hard-kill at %s (nth=%d) on node %d, t=%.1f"
+                % (self.point, self.nth, self.node, scn.elapsed())
+            )
+
+        def restart():
+            self.disarm()
+            raw = scn.sim._raw_key(key)
+            if raw not in scn.sim._crashed:
+                scn.note(
+                    "hard-kill never fired — node %d still alive at"
+                    " restart deadline" % self.node
+                )
+                return
+            self.n_kills += 1
+            app = scn.sim.restart_node(key)
+            self.selfcheck = app.last_selfcheck
+            scn.mark_recovery_start()
+            scn.note(
+                "restarted hard-killed node %d at t=%.1f (selfcheck=%s)"
+                % (
+                    self.node,
+                    scn.elapsed(),
+                    (self.selfcheck or {}).get("status"),
+                )
+            )
+
+        self._at(scn, self.at, arm_injector)
+        self._at(scn, self.restart_at, restart)
+
+    def disarm(self) -> None:
+        from ..util import fs
+
+        if self._inj is not None:
+            fs.remove_kill_hook(self._inj)
+
+    # Scenario.run verdict hook
+    def verify_outcome(self, failures: List[str]) -> None:
+        if self.n_kills < 1:
+            failures.append(
+                "hard_kill_mid_close: the kill-point injector never"
+                " fired (no close crossed %s)" % self.point
+            )
+            return
+        status = (self.selfcheck or {}).get("status")
+        if status not in ("ok", "repaired"):
+            failures.append(
+                "hard_kill_mid_close: restarted node's boot self-check"
+                " reported %r" % status
+            )
+
+
+@dataclass
 class ByzantineFlood(Fault):
     """Invalid-signature envelope + transaction flood at volume, against
     ``target`` (node index), between ``at`` and ``until`` on a ``tick``

@@ -23,6 +23,13 @@ Fault classes (ROADMAP #5 / ISSUE r12 acceptance):
 - ``crash_restart``     — validator hard-crash with a 3-of-3 quorum (the
                           network halts) and restart from its on-disk
                           state; recovery time measured
+- ``hard_kill_mid_close`` — a REAL kill (ISSUE r18, not graceful_stop):
+                          a storage-fault injector unwinds the node's
+                          in-flight close at a named durable-write
+                          kill-point (close.pre-commit) and reaps it
+                          with no shutdown hooks; the restart must pass
+                          the boot self-check (main/selfcheck.py)
+                          before consensus recovers
 - ``catchup_load``      — node partitioned past MAX_SLOTS_TO_REMEMBER
                           while the network closes through checkpoint
                           boundaries under load; rejoin via history-archive
@@ -46,6 +53,7 @@ from ..overlay.loopback import FaultProfile
 from .faults import (
     ByzantineFlood,
     CrashRestart,
+    HardKillMidClose,
     OverloadStorm,
     Partition,
     PartitionUntilCheckpoint,
@@ -60,6 +68,7 @@ FAULT_CLASSES = (
     "byzantine_flood_halfagg",
     "slow_lossy",
     "crash_restart",
+    "hard_kill_mid_close",
     "catchup_load",
     "slow_reader",
     "overload_storm",
@@ -164,6 +173,26 @@ def small_specs(seed: int = 1) -> Dict[str, ScenarioSpec]:
             seed=seed,
             disk_db=True,
             faults=[CrashRestart(at=2.0, restart_at=8.0, node=2)],
+            target_ledgers=14,
+            min_ledgers_per_sec=0.1,
+            max_recovery_ms=20_000,
+            timeout=240.0,
+        ),
+        # the storage survival plane's chaos class (ISSUE r18): a REAL
+        # kill — the injector unwinds node 2's close at close.pre-commit
+        # (every durable close artifact staged, COMMIT not run) and the
+        # node is reaped with NO graceful shutdown; 3-of-3 quorum so the
+        # kill halts consensus outright, and the restart must pass the
+        # boot self-check before recovery is measured.  Deterministic
+        # two-run replay like crash_restart.
+        "hard_kill_mid_close": ScenarioSpec(
+            name="hard_kill_mid_close_small",
+            fault_class="hard_kill_mid_close",
+            n_nodes=3,
+            threshold=3,
+            seed=seed,
+            disk_db=True,
+            faults=[HardKillMidClose(at=2.0, restart_at=8.0, node=2)],
             target_ledgers=14,
             min_ledgers_per_sec=0.1,
             max_recovery_ms=20_000,
@@ -296,6 +325,14 @@ def big_specs(seed: int = 1) -> Dict[str, ScenarioSpec]:
             # 8-node shape keeps BFT majority; crash a TIER node so ring
             # consensus must route around it, then recover on restart
             big.faults = [CrashRestart(at=2.0, restart_at=10.0, node=5)]
+            big.threshold = None
+            big.max_recovery_ms = 40_000
+        elif cls == "hard_kill_mid_close":
+            # hard-kill a TIER node mid-close while the ring keeps
+            # closing; the restart must self-check + replay the gap
+            big.faults = [
+                HardKillMidClose(at=2.0, restart_at=10.0, node=5)
+            ]
             big.threshold = None
             big.max_recovery_ms = 40_000
         elif cls == "catchup_load":

@@ -260,7 +260,11 @@ class Scenario:
                 marks_recovery = (
                     getattr(f, "heal_at", None) is not None
                     or type(f).__name__
-                    in ("CrashRestart", "PartitionUntilCheckpoint")
+                    in (
+                        "CrashRestart",
+                        "HardKillMidClose",
+                        "PartitionUntilCheckpoint",
+                    )
                 )
                 if marks_recovery:
                     self._expected_recoveries += 1
@@ -387,6 +391,13 @@ class Scenario:
                         % (sb.sendq_max_stall_ms, stall_budget)
                     )
             for f in spec.faults:
+                # fault-specific verdicts (the hard-kill class asserts
+                # its kill fired and the restarted node's self-check
+                # repaired; future classes plug in the same way)
+                outcome = getattr(f, "verify_outcome", None)
+                if outcome is not None:
+                    outcome(failures)
+            for f in spec.faults:
                 checker = getattr(f, "assert_cache_unpolluted", None)
                 if checker is not None:
                     try:
@@ -413,6 +424,11 @@ class Scenario:
             return ScenarioResult(spec.name, not failures, failures, sb)
         finally:
             self.done = True
+            for f in spec.faults:
+                # remove any process-global fs kill hooks a fault armed
+                disarm = getattr(f, "disarm", None)
+                if disarm is not None:
+                    disarm()
             for t in self._fault_timers:
                 t.cancel()
             if self._doctor_timer is not None:

@@ -265,6 +265,48 @@ class Simulation:
         self._crashed[raw] = (secret, app.config)
         log.info("chaos: crashed node %s", raw.hex()[:8])
 
+    def kill_node(self, key) -> None:
+        """The NON-graceful crash: reap a node whose 'process' just died
+        (a SimulatedProcessKill unwound its in-flight work — any open
+        SQL transaction already rolled back through the context
+        managers, exactly what a restart would observe).  Timers are
+        cancelled because a dead process's timers cease to exist; the
+        DB connection is abandoned (marked closed, no clean shutdown),
+        and NOTHING is persisted on the way down — the difference from
+        crash_node's graceful_stop."""
+        raw = self._raw_key(key)
+        app = self.nodes.pop(raw)
+        secret = app.config.NODE_SEED
+        for conn, (ia, ib) in self._live:
+            if raw in (ia, ib):
+                self._sever_connection(conn)
+        # a dead process's timers vanish with it — cancel without any
+        # state-persisting shutdown hooks
+        if app.herder is not None:
+            app.herder.shutdown()
+        if app.overlay_manager is not None:
+            app.overlay_manager.shutdown()
+        if app.command_handler is not None:
+            app.command_handler.stop()
+        if app.process_manager is not None:
+            app.process_manager.shutdown()
+        app.database.closed = True
+        try:
+            app.database._conn.close()
+        except Exception:
+            pass
+        self._crashed[raw] = (secret, app.config)
+        log.info("chaos: hard-killed node %s", raw.hex()[:8])
+
+    def _reap_simulated_kill(self, e) -> bool:
+        """Map a SimulatedProcessKill's context (the dying node's
+        Database) back to the node and reap it; True if a node died."""
+        for raw, app in list(self.nodes.items()):
+            if app.database is getattr(e, "ctx", None):
+                self.kill_node(raw)
+                return True
+        return False
+
     def restart_node(self, key, force_scp: bool = True) -> Application:
         """Bring a crashed validator back on its on-disk state and rejoin
         it to the expected topology (the doctor re-links immediately)."""
@@ -279,17 +321,52 @@ class Simulation:
         return app
 
     # -- cranking -----------------------------------------------------------
+    # Every crank entry point rides out SimulatedProcessKill the same
+    # way: an armed storage-fault injector (scenarios/storagefaults.py)
+    # killing a node mid-crank reaps THAT node and cranking CONTINUES —
+    # process death is a fault the rest of the network survives, not a
+    # harness error.
+
     def crank_all_nodes(self, n: int = 1) -> int:
+        from ..util.fs import SimulatedProcessKill
+
         total = 0
         for _ in range(n):
-            total += self.clock.crank()
+            try:
+                total += self.clock.crank()
+            except SimulatedProcessKill as e:
+                if not self._reap_simulated_kill(e):
+                    raise  # no live node owns this kill — harness bug
         return total
 
     def crank_until(self, pred: Callable[[], bool], timeout: float) -> bool:
-        return self.clock.crank_until(pred, timeout)
+        from ..util.fs import SimulatedProcessKill
+
+        deadline = self.clock.now() + timeout
+        while True:
+            remaining = deadline - self.clock.now()
+            if remaining <= 0:
+                return pred()
+            try:
+                return self.clock.crank_until(pred, remaining)
+            except SimulatedProcessKill as e:
+                if not self._reap_simulated_kill(e):
+                    raise  # no live node owns this kill — harness bug
 
     def crank_for_at_least(self, seconds: float) -> None:
-        self.clock.crank_for(seconds)
+        from ..util.fs import SimulatedProcessKill
+
+        deadline = self.clock.now() + seconds
+        while True:
+            remaining = deadline - self.clock.now()
+            if remaining <= 0:
+                return
+            try:
+                self.clock.crank_for(remaining)
+                return
+            except SimulatedProcessKill as e:
+                if not self._reap_simulated_kill(e):
+                    raise  # no live node owns this kill — harness bug
 
     # -- predicates (Simulation.h:59-63) ------------------------------------
     def have_all_externalized(self, num_ledgers: int) -> bool:

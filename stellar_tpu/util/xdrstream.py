@@ -8,6 +8,7 @@ reference so bucket hashes agree.
 
 from __future__ import annotations
 
+import os
 import struct
 from typing import Iterator, Optional, Type
 
@@ -15,9 +16,21 @@ from ..xdr.base import XdrError, codec_of
 
 
 class XDROutputFileStream:
-    def __init__(self, path: str, hasher=None):
+    """``durable=True`` makes close() fsync the stream before returning
+    (crash-safe staging; util/fs.py discipline), with ``point`` naming
+    the site's storage kill-points (``<point>:write`` while the payload
+    is complete-but-unsynced, ``<point>:staged`` after the fsync)."""
+
+    def __init__(self, path: str, hasher=None, durable: bool = False,
+                 point: str = None, ctx=None):
+        # streaming writer for a fresh staging path; durability comes
+        # from the fsync-on-close below, adoption/rename from the caller
         self._f = open(path, "wb")
+        self._path = path
         self._hasher = hasher
+        self._durable = durable
+        self._point = point
+        self._ctx = ctx
         self.bytes_put = 0
 
     def write_one(self, obj) -> None:
@@ -31,6 +44,23 @@ class XDROutputFileStream:
             self._hasher.add(frame)
 
     def close(self) -> None:
+        if self._durable and not self._f.closed:
+            from . import fs
+
+            self._f.flush()
+            if self._point is not None:
+                fs.kill_point(
+                    self._point + fs.STAGE_WRITE, path=self._path,
+                    ctx=self._ctx,
+                )
+            os.fsync(self._f.fileno())
+            self._f.close()
+            if self._point is not None:
+                fs.kill_point(
+                    self._point + fs.STAGE_STAGED, path=self._path,
+                    ctx=self._ctx,
+                )
+            return
         self._f.close()
 
     def __enter__(self):

@@ -1,0 +1,40 @@
+# analysis-fixture-path: bucket/sanctioned_writer_fixture.py
+# NEGATIVE: the sanctioned shapes — reads, the util/fs.py helpers
+# (which carry the fsync/rename discipline AND the kill-points), the
+# durable stream, and rename-looking calls that are not os renames.
+from stellar_tpu.util import fs
+from stellar_tpu.util.xdrstream import XDROutputFileStream
+
+
+def read_bucket(path):
+    with open(path, "rb") as f:  # read mode is free
+        return f.read()
+
+
+def read_default_mode(path):
+    with open(path) as f:  # default 'r'
+        return f.read()
+
+
+def write_durably(path, data):
+    fs.durable_write(path, data, point="bucket.fixture")
+
+
+def stage_then_adopt(tmp, final, data):
+    fs.stage_write(tmp, data, point="bucket.fixture")
+    fs.durable_rename(tmp, final, point="bucket.fixture")
+
+
+def stream_durably(path, entries):
+    with XDROutputFileStream(path, durable=True, point="bucket.fixture") as out:
+        for e in entries:
+            out.write_one(e)
+
+
+class Catalog:
+    def replace(self, a, b):
+        return (a, b)
+
+
+def not_an_os_rename(catalog):
+    catalog.replace("x", "y")  # method named replace on a non-os object

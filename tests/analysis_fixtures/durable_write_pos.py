@@ -1,0 +1,28 @@
+# analysis-fixture-path: bucket/rogue_writer_fixture.py
+# POSITIVE: durable artifacts written with no fsync/atomic-rename
+# discipline and no storage kill-point — bare write-mode opens (every
+# spelling) and raw os renames placing files a kill can tear.
+import os
+
+
+def write_bucket(path, data):
+    with open(path, "wb") as f:  # torn-write hole, no kill-point
+        f.write(data)
+
+
+def write_state_kw(path, text):
+    with open(path, mode="w") as f:  # keyword-mode spelling, same hole
+        f.write(text)
+
+
+def append_journal(path, line):
+    with open(path, "a") as f:  # append is a write too
+        f.write(line)
+
+
+def adopt(tmp, final):
+    os.rename(tmp, final)  # no fsync(file) before, no fsync(dir) after
+
+
+def adopt_replace(tmp, final):
+    os.replace(tmp, final)  # same hole via the atomic spelling

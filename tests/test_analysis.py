@@ -58,6 +58,7 @@ RULE_FIXTURES = [
     ("determinism", "determinism_pos.py", "determinism_neg.py", 6),
     ("metrics-fast-lane", "metrics_fast_lane_pos.py", "metrics_fast_lane_neg.py", 5),
     ("send-path", "send_path_pos.py", "send_path_neg.py", 3),
+    ("durable-write", "durable_write_pos.py", "durable_write_neg.py", 5),
     ("gil-region", "gil_region_pos.c", "gil_region_neg.c", 2),
 ]
 

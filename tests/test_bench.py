@@ -173,6 +173,9 @@ def test_bench_close_subprocess_success_path():
     # every close line names its dispatch mode (ISSUE r13): the forced-CPU
     # contract run is unsharded by definition
     assert out["sig_mesh_devices"] == 0
+    # boot self-check cost (ISSUE r18) rides every close line so a
+    # selfcheck regression is visible without a real restart
+    assert out["selfcheck_ms"] >= 0
 
 
 def test_probe_tpu_alive_success_path(monkeypatch):

@@ -80,6 +80,32 @@ def test_crash_restart_small():
     assert sb.recovery_ms is not None and sb.recovery_ms > 0
 
 
+def test_hard_kill_mid_close_small():
+    """The storage chaos class (ISSUE r18): a REAL kill, not
+    graceful_stop — the in-process storage-fault injector unwinds node
+    2's close at the close.pre-commit kill-point (bucket files written
+    and renamed, header/LCL/publish rows staged, COMMIT not run) and
+    Simulation.kill_node reaps it with no shutdown hooks.  The 3-of-3
+    quorum halts; the restart must pass the boot self-check, replay the
+    interrupted close from its restored SCP state, and consensus must
+    recover inside the floor — with invariants all-on throughout."""
+    verify_cache().clear()
+    spec = small_specs()["hard_kill_mid_close"]
+    kill = spec.faults[0]
+    from stellar_tpu.scenarios.scenario import Scenario
+
+    r = Scenario(spec).run()
+    assert r.ok, r.failures
+    sb = r.scoreboard
+    assert sb.ledgers_closed >= 10
+    assert sb.invariant_violations == 0
+    assert sb.ledgers_agree and sb.final_hash
+    # the kill genuinely fired mid-close and the reboot self-checked
+    assert kill.n_kills == 1
+    assert (kill.selfcheck or {}).get("status") in ("ok", "repaired")
+    assert sb.recovery_ms is not None and sb.recovery_ms > 0
+
+
 def test_catchup_under_load_small():
     """A node partitioned past MAX_SLOTS_TO_REMEMBER while the majority
     closes through checkpoint boundaries under load; it rejoins via
@@ -219,6 +245,7 @@ def test_overload_storm_small():
         "byzantine_flood_halfagg",
         "slow_lossy",
         "crash_restart",
+        "hard_kill_mid_close",
         "slow_reader",
         "overload_storm",
     ],

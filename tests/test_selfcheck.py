@@ -1,0 +1,366 @@
+"""Boot self-check & repair (stellar_tpu/main/selfcheck.py, ISSUE r18):
+the restart half of the crash-survival contract, driven against a real
+disk-backed node with a real (cp-based) history archive.
+
+Also the satellite coverage for ``BucketManager.check_for_missing_bucket
+_files`` + ``check_db`` against genuinely truncated, bit-flipped, and
+zero-length bucket files — previously only the happy path ran.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from stellar_tpu.main.application import Application
+from stellar_tpu.scenarios.killsweep import (
+    CLOSE_T0,
+    _child_config,
+    _drain_publish,
+    _window_txs,
+)
+from stellar_tpu.scenarios.storagefaults import corrupt_file
+from stellar_tpu.tx.testutils import close_ledger_on
+from stellar_tpu.util.clock import REAL_TIME, VirtualClock
+from stellar_tpu.xdr.base import XdrError
+
+# close to exactly the checkpoint ledger (freq 4 -> checkpoint at 7) so
+# EVERY bucket the persisted archive state references is published and
+# therefore re-downloadable by the boot repair
+TARGET = 7
+
+
+def build_node(workdir: str, target: int = TARGET):
+    """A standalone disk-backed validator closed to ``target`` with its
+    checkpoint published to the workdir archive (the kill-sweep child's
+    exact window, run in-process)."""
+    os.makedirs(f"{workdir}/archive", exist_ok=True)
+    fresh = not os.path.exists(f"{workdir}/node.db")
+    cfg = _child_config(workdir)
+    clock = VirtualClock(REAL_TIME)
+    app = Application.create(clock, cfg, new_db=fresh)
+    app.start()
+    lm = app.ledger_manager
+    while lm.get_last_closed_ledger_num() < target:
+        seq = lm.current.header.ledgerSeq
+        close_ledger_on(app, CLOSE_T0 + seq * 5, txs=_window_txs(app, seq))
+    assert _drain_publish(app), "publish did not drain"
+    return app, clock
+
+
+def stop_node(app, clock):
+    app.graceful_stop()
+    clock.shutdown()
+
+
+def restart_node(workdir: str):
+    cfg = _child_config(workdir)
+    clock = VirtualClock(REAL_TIME)
+    app = Application.create(clock, cfg, new_db=False)
+    app.start()
+    return app, clock
+
+
+def referenced_bucket_hashes(app):
+    from stellar_tpu.history.archive import HistoryArchiveState
+    from stellar_tpu.main.persistentstate import K_HISTORY_ARCHIVE_STATE
+
+    has = HistoryArchiveState.from_json(
+        app.persistent_state.get_state(K_HISTORY_ARCHIVE_STATE)
+    )
+    return [h for h in has.all_bucket_hashes() if any(h)]
+
+
+def _bitflip(path: str) -> None:
+    with open(path, "r+b") as f:
+        f.seek(os.path.getsize(path) // 2)
+        b = f.read(1)
+        f.seek(-1, 1)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+def _zero(path: str) -> None:
+    with open(path, "r+b") as f:
+        f.truncate(0)
+
+
+CORRUPTIONS = {
+    "truncated": lambda p: corrupt_file(p, "truncate"),
+    "torn": lambda p: corrupt_file(p, "torn"),
+    "bitflip": _bitflip,
+    "zero": _zero,
+}
+
+
+# -- corrupt-bucket detection + archive repair -------------------------------
+
+
+@pytest.mark.parametrize("kind", sorted(CORRUPTIONS))
+def test_corrupt_bucket_quarantined_and_repaired_from_archive(
+    tmp_path, kind
+):
+    """The full survival loop: corrupt a referenced bucket file on disk
+    → the boot self-check detects it by re-hash, quarantines it, and
+    the existing boot repair re-downloads it from the archive → the
+    node loads its ledger with the bucket list hash intact."""
+    wd = str(tmp_path)
+    app, clock = build_node(wd)
+    victim = referenced_bucket_hashes(app)[-1]
+    path = app.bucket_manager.bucket_filename(victim)
+    lcl = app.ledger_manager.last_closed
+    stop_node(app, clock)
+
+    CORRUPTIONS[kind](path)
+    app2, clock2 = restart_node(wd)
+    try:
+        sc = app2.last_selfcheck
+        assert sc["status"] == "repaired", sc
+        assert sc["buckets_quarantined"] == 1
+        # repaired back to the identical chain + bucket list
+        assert app2.ledger_manager.last_closed.hash == lcl.hash
+        assert (
+            app2.bucket_manager.get_hash() == lcl.header.bucketListHash
+        )
+        # the re-downloaded file hashes clean
+        assert app2.bucket_manager.verify_bucket_file(victim) == "ok"
+        assert app2.bucket_manager.check_db()["status"] == "ok"
+    finally:
+        stop_node(app2, clock2)
+
+
+def test_missing_bucket_repaired_from_archive(tmp_path):
+    """Deleted (not corrupt) file: reported missing by the self-check,
+    repaired by the pre-existing download path."""
+    wd = str(tmp_path)
+    app, clock = build_node(wd)
+    victim = referenced_bucket_hashes(app)[-1]
+    path = app.bucket_manager.bucket_filename(victim)
+    stop_node(app, clock)
+
+    os.unlink(path)
+    app2, clock2 = restart_node(wd)
+    try:
+        sc = app2.last_selfcheck
+        assert sc["buckets_missing"] == 1
+        assert sc["buckets_quarantined"] == 0
+        assert app2.bucket_manager.verify_bucket_file(victim) == "ok"
+    finally:
+        stop_node(app2, clock2)
+
+
+# -- satellite: check_for_missing_bucket_files + check_db vs corruption ------
+
+
+def test_check_for_missing_sees_deleted_and_quarantined(tmp_path):
+    from stellar_tpu.history.archive import HistoryArchiveState
+    from stellar_tpu.main.persistentstate import K_HISTORY_ARCHIVE_STATE
+
+    app, clock = build_node(str(tmp_path))
+    try:
+        bm = app.bucket_manager
+        has = HistoryArchiveState.from_json(
+            app.persistent_state.get_state(K_HISTORY_ARCHIVE_STATE)
+        )
+        assert bm.check_for_missing_bucket_files(has) == []
+        victim = referenced_bucket_hashes(app)[0]
+        # existence check alone does NOT see corruption ...
+        corrupt_file(bm.bucket_filename(victim), "truncate")
+        assert bm.check_for_missing_bucket_files(has) == []
+        assert bm.verify_bucket_files(has)["corrupt"] == [victim]
+        # ... until quarantine turns "corrupt" into "missing"
+        bm.quarantine_bucket_file(victim)
+        assert bm.check_for_missing_bucket_files(has) == [victim]
+    finally:
+        stop_node(app, clock)
+
+
+@pytest.mark.parametrize("kind", sorted(CORRUPTIONS))
+def test_check_db_fails_loudly_on_corrupt_bucket(tmp_path, kind):
+    """check_db replays the bucket list against SQL: every corruption
+    class must surface as a raised error (truncated/torn records fail
+    XDR framing; bit-flips and zero-length files diverge the replayed
+    state), never as a clean report."""
+    app, clock = build_node(str(tmp_path))
+    try:
+        bm = app.bucket_manager
+        assert bm.check_db()["status"] == "ok"
+        # corrupt the NEWEST live bucket: its entries carry the current
+        # values (older levels hold stale shadows), so damage here must
+        # change what the replay reconstructs — a deep bucket's entries
+        # can be fully masked by newer levels and slip through, which is
+        # exactly why the boot self-check re-hashes EVERY file instead
+        # of trusting the replay to notice
+        victim = next(
+            b
+            for lev in bm.bucket_list.levels
+            for b in (lev.curr, lev.snap)
+            if not b.is_empty() and b.path
+        )
+        CORRUPTIONS[kind](victim.path)
+        with pytest.raises((RuntimeError, XdrError)):
+            bm.check_db()
+    finally:
+        stop_node(app, clock)
+
+
+# -- the other repair legs ---------------------------------------------------
+
+
+def test_stale_tmp_dirs_reaped_and_metered(tmp_path):
+    wd = str(tmp_path)
+    app, clock = build_node(wd)
+    stop_node(app, clock)
+    # a killed process's leftovers: staging dirs + a torn merge tmp
+    os.makedirs(f"{wd}/tmp/publish-7-deadbeef")
+    os.makedirs(f"{wd}/tmp/catchup-cafecafe")
+    with open(f"{wd}/buckets/tmp-bucket-feedface.xdr", "wb") as f:
+        f.write(b"torn")
+    app2, clock2 = restart_node(wd)
+    try:
+        sc = app2.last_selfcheck
+        assert sc["tmp_reaped"] >= 3
+        assert sc["status"] == "repaired"
+        assert not os.path.exists(f"{wd}/tmp/publish-7-deadbeef")
+        assert not os.path.exists(f"{wd}/buckets/tmp-bucket-feedface.xdr")
+        # metered on the fast lane
+        ms = app2.metrics.to_json()
+        assert ms["selfcheck.boot.tmp-reaped"]["count"] >= 3
+    finally:
+        stop_node(app2, clock2)
+
+
+def test_torn_publish_queue_row_dropped(tmp_path):
+    wd = str(tmp_path)
+    app, clock = build_node(wd)
+    app.database.execute(
+        "INSERT INTO publishqueue (ledger, state) VALUES (?,?)",
+        (99, "{not json"),
+    )
+    stop_node(app, clock)
+    app2, clock2 = restart_node(wd)
+    try:
+        sc = app2.last_selfcheck
+        assert sc["publish_rows_dropped"] == 1
+        assert sc["status"] == "repaired"
+        from stellar_tpu.history import publish as publish_queue
+
+        assert publish_queue.queued_checkpoints(app2.database) == []
+    finally:
+        stop_node(app2, clock2)
+
+
+def test_undecodable_scp_state_cleared(tmp_path):
+    from stellar_tpu.main.persistentstate import K_LAST_SCP_DATA
+
+    wd = str(tmp_path)
+    app, clock = build_node(wd)
+    app.persistent_state.set_state(K_LAST_SCP_DATA, "!!! not base64 !!!")
+    stop_node(app, clock)
+    app2, clock2 = restart_node(wd)
+    try:
+        assert app2.last_selfcheck["status"] == "repaired"
+        assert (
+            app2.persistent_state.get_state(K_LAST_SCP_DATA) is None
+        )
+    finally:
+        stop_node(app2, clock2)
+
+
+def test_forward_header_garbage_truncated(tmp_path):
+    """Header rows beyond the LCL can only come from torn storage (the
+    close writes header + pointer in one transaction) — truncated."""
+    wd = str(tmp_path)
+    app, clock = build_node(wd)
+    lcl = app.ledger_manager.last_closed
+    app.database.execute(
+        "INSERT INTO ledgerheaders (ledgerhash, prevhash, bucketlisthash,"
+        " ledgerseq, closetime, data) VALUES (?,?,?,?,?,?)",
+        ("ff" * 32, "ee" * 32, "dd" * 32, lcl.header.ledgerSeq + 3, 0, "xx"),
+    )
+    stop_node(app, clock)
+    app2, clock2 = restart_node(wd)
+    try:
+        sc = app2.last_selfcheck
+        assert sc["headers_truncated"] == 1
+        assert sc["status"] == "repaired"
+        assert app2.ledger_manager.last_closed.hash == lcl.hash
+    finally:
+        stop_node(app2, clock2)
+
+
+def test_damaged_lcl_pointer_rolls_back_to_consistent_header(tmp_path):
+    from stellar_tpu.main.persistentstate import K_LAST_CLOSED_LEDGER
+
+    wd = str(tmp_path)
+    app, clock = build_node(wd)
+    lcl = app.ledger_manager.last_closed
+    app.persistent_state.set_state(K_LAST_CLOSED_LEDGER, "deadbeef")
+    stop_node(app, clock)
+    app2, clock2 = restart_node(wd)
+    try:
+        sc = app2.last_selfcheck
+        assert sc["status"] == "repaired", sc
+        assert any("rolled lastclosedledger" in r for r in sc["repairs"])
+        # the newest consistent header IS the real LCL, so the rollback
+        # restores the exact pre-damage chain
+        assert app2.ledger_manager.last_closed.hash == lcl.hash
+    finally:
+        stop_node(app2, clock2)
+
+
+def test_selfcheck_admin_route_and_rerun(tmp_path):
+    app, clock = build_node(str(tmp_path))
+    try:
+        out = app.command_handler.routes["selfcheck"]({})
+        assert out["status"] in ("ok", "repaired")
+        assert out["mode"] == "boot-repair"
+        rerun = app.command_handler.routes["selfcheck"]({"rerun": "1"})
+        assert rerun["status"] == "ok"
+        assert rerun["mode"] == "verify-only"
+        assert rerun["buckets_checked"] >= 1
+        # the rerun is a fresh report, not a rewrite of the boot one
+        assert app.last_selfcheck is out
+    finally:
+        stop_node(app, clock)
+
+
+def test_selfcheck_rerun_is_read_only_on_live_damage(tmp_path):
+    """?rerun=1 on a LIVE node must REPORT damage, never repair it —
+    quarantining live would strand the bucket until restart (the
+    re-download path only runs at boot), and the boot counters must not
+    be re-reported as fresh repairs."""
+    app, clock = build_node(str(tmp_path))
+    try:
+        victim = referenced_bucket_hashes(app)[-1]
+        path = app.bucket_manager.bucket_filename(victim)
+        _bitflip(path)
+        rerun = app.command_handler.routes["selfcheck"]({"rerun": "1"})
+        assert rerun["status"] == "corrupt"
+        assert rerun["repairs"] == []
+        assert rerun["buckets_quarantined"] == 0
+        assert any("fails its content hash" in p for p in rerun["problems"])
+        # the file is still in place (NOT quarantined) for the next boot
+        assert os.path.exists(path)
+        assert app.bucket_manager.verify_bucket_file(victim) == "corrupt"
+        # no stale boot tmp-reap counts resurface as rerun repairs
+        assert rerun["tmp_reaped"] == 0
+    finally:
+        stop_node(app, clock)
+
+
+def test_selfcheck_knob_off_skips(tmp_path):
+    wd = str(tmp_path)
+    app, clock = build_node(wd)
+    stop_node(app, clock)
+    cfg = _child_config(wd)
+    cfg.SELFCHECK_ON_BOOT = False
+    clock2 = VirtualClock(REAL_TIME)
+    app2 = Application.create(clock2, cfg, new_db=False)
+    app2.start()
+    try:
+        assert app2.last_selfcheck is None
+        out = app2.command_handler.routes["selfcheck"]({})
+        assert out["status"] == "not-run"
+    finally:
+        stop_node(app2, clock2)

@@ -1,0 +1,192 @@
+"""The storage fault plane (ISSUE r18): util/fs.py durable-write helpers
++ kill-point registry, and the scenarios/storagefaults.py injector —
+deterministic nth-hit counting, owner scoping, the corruption modes, and
+the hard-exit leg in a real subprocess.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from stellar_tpu.scenarios import storagefaults as sf
+from stellar_tpu.util import fs
+
+
+@pytest.fixture(autouse=True)
+def _clean_hooks():
+    yield
+    fs.clear_kill_hooks()
+
+
+# -- durable-write helpers ---------------------------------------------------
+
+
+def test_durable_write_creates_and_overwrites(tmp_path):
+    p = tmp_path / "state.json"
+    fs.durable_write(str(p), b"one")
+    assert p.read_bytes() == b"one"
+    fs.durable_write(str(p), "two-as-str")
+    assert p.read_bytes() == b"two-as-str"
+    # no .durable- staging orphans left behind on the success path
+    assert [f for f in os.listdir(tmp_path) if f.startswith(".durable-")] == []
+
+
+def test_durable_write_failure_removes_tmp(tmp_path):
+    p = tmp_path / "x"
+
+    class Boom(Exception):
+        pass
+
+    def bomb(name, path, ctx):
+        raise Boom()
+
+    fs.add_kill_hook(bomb)
+    with pytest.raises(Boom):
+        fs.durable_write(str(p), b"data", point="fixture.site")
+    fs.clear_kill_hooks()
+    assert not p.exists()
+    assert [f for f in os.listdir(tmp_path) if f.startswith(".durable-")] == []
+
+
+def test_stage_write_then_durable_rename(tmp_path):
+    tmp, final = str(tmp_path / "stage"), str(tmp_path / "final")
+    fs.stage_write(tmp, b"payload")
+    fs.durable_rename(tmp, final)
+    assert not os.path.exists(tmp)
+    with open(final, "rb") as f:
+        assert f.read() == b"payload"
+
+
+def test_registry_names_the_durable_surface():
+    """The sweep's enumerable inventory: every registered point, with
+    the acceptance floor (>= 25 distinct points across close, bucket,
+    SCP persist, and publish) pinned here so a refactor that silently
+    drops a kill-point fails loudly."""
+    from stellar_tpu.scenarios.killsweep import ensure_points_registered
+
+    ensure_points_registered()
+    points = fs.registered_kill_points()
+    assert len(points) >= 25, sorted(points)
+    for expected in (
+        "bucket.fresh:write",
+        "bucket.merge:write",
+        "bucket.adopt:renamed",
+        "db.commit:pre",
+        "close.pre-commit",
+        "close.post-commit",
+        "scp.persist:pre",
+        "publish.queue-row",
+        "publish.snapshot.ledger:write",
+        "publish.commit-json:renamed",
+    ):
+        assert expected in points, expected
+
+
+# -- the injector ------------------------------------------------------------
+
+
+def test_trace_hook_records_ordered_hits(tmp_path):
+    trace = str(tmp_path / "trace.tsv")
+    t = sf.KillPointTrace(trace)
+    fs.add_kill_hook(t)
+    fs.kill_point("a.site:write", path="/x")
+    fs.kill_point("b.site", ctx=object())
+    fs.kill_point("a.site:write")
+    t.close()
+    assert sf.KillPointTrace.read_points(trace) == ["a.site:write", "b.site"]
+
+
+def test_injector_nth_counting_and_owner_scope():
+    owner_a, owner_b = object(), object()
+    inj = sf.StorageFaultInjector(
+        "p.site", nth=2, mode="raise", owner=owner_a
+    )
+    fs.add_kill_hook(inj)
+    fs.kill_point("p.site", ctx=owner_b)  # wrong owner: not counted
+    fs.kill_point("other.site", ctx=owner_a)  # wrong point: not counted
+    fs.kill_point("p.site", ctx=owner_a)  # hit 1 of 2
+    assert not inj.fired
+    with pytest.raises(fs.SimulatedProcessKill) as ei:
+        fs.kill_point("p.site", ctx=owner_a)  # hit 2: fires
+    assert ei.value.point == "p.site"
+    assert ei.value.ctx is owner_a
+    assert inj.fired
+    # a fired injector goes permanently passive
+    fs.kill_point("p.site", ctx=owner_a)
+
+
+@pytest.mark.parametrize("mode", ["truncate", "torn"])
+def test_corruption_modes(tmp_path, mode):
+    p = tmp_path / "bucket.xdr"
+    p.write_bytes(b"A" * 1000)
+    sf.corrupt_file(str(p), mode)
+    data = p.read_bytes()
+    if mode == "truncate":
+        assert data == b"A" * 500
+    else:
+        assert data[:500] == b"A" * 500
+        assert data[500:] == sf.TORN_GARBAGE
+        assert len(data) == 500 + len(sf.TORN_GARBAGE)
+
+
+def test_parse_arm_spec_with_stage_suffixes():
+    inj = sf.parse_arm_spec("bucket.fresh:write")
+    assert (inj.point, inj.nth, inj.mode) == ("bucket.fresh:write", 1, "exit")
+    inj = sf.parse_arm_spec("bucket.fresh:write:3:torn")
+    assert (inj.point, inj.nth, inj.mode) == ("bucket.fresh:write", 3, "torn")
+    inj = sf.parse_arm_spec("db.commit:pre:2")
+    assert (inj.point, inj.nth, inj.mode) == ("db.commit:pre", 2, "exit")
+    # an unknown trailing token is part of the point NAME (stage
+    # suffixes contain ':'), so only emptiness is a parse error
+    inj = sf.parse_arm_spec("p.site:odd-stage")
+    assert (inj.point, inj.nth, inj.mode) == ("p.site:odd-stage", 1, "exit")
+    with pytest.raises(ValueError):
+        sf.parse_arm_spec(":")
+    with pytest.raises(ValueError):
+        sf.StorageFaultInjector("p", mode="bogus")
+
+
+def test_exit_mode_kills_a_real_process(tmp_path):
+    """The hard-kill leg end to end in a subprocess: install from env,
+    hit the point, die with the SIGKILL-shaped exit code, leaving the
+    file corrupt on disk."""
+    victim = tmp_path / "artifact"
+    script = (
+        "from stellar_tpu.scenarios.storagefaults import install_from_env\n"
+        "from stellar_tpu.util import fs\n"
+        "install_from_env()\n"
+        "fs.stage_write(%r, b'B' * 100, point='victim.site')\n"
+        "print('survived')\n" % str(victim)
+    )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["STELLAR_TPU_KILL_POINT"] = "victim.site:write:1:torn"
+    r = subprocess.run(
+        [sys.executable, "-c", script],
+        env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == sf.KILL_EXIT_CODE, (r.returncode, r.stdout, r.stderr)
+    assert "survived" not in r.stdout
+    data = victim.read_bytes()
+    assert data[:50] == b"B" * 50 and data[50:] == sf.TORN_GARBAGE
+
+
+def test_durable_stream_hits_its_points(tmp_path):
+    from stellar_tpu.util.xdrstream import XDROutputFileStream
+    from stellar_tpu.xdr.ledger import LedgerHeader
+
+    hits = []
+    fs.add_kill_hook(lambda name, path, ctx: hits.append(name))
+    path = str(tmp_path / "stream.xdr")
+    with XDROutputFileStream(path, durable=True, point="stream.site") as out:
+        out.write_one(LedgerHeader())
+    assert hits == ["stream.site:write", "stream.site:staged"]
+    # and the payload round-trips
+    from stellar_tpu.util.xdrstream import XDRInputFileStream
+
+    with XDRInputFileStream(path) as f:
+        assert f.read_one(LedgerHeader) is not None

@@ -232,6 +232,22 @@ class TestTmpDir:
         mgr.forget(d)
         assert not os.path.exists(d.get_name())
 
+    def test_orphans_reaped_at_boot_live_dirs_guarded(self, tmp_path):
+        """ISSUE r18 satellite: a killed process's publish-*/catchup-*
+        staging dirs are reaped (and counted) at the next boot, but a
+        runtime re-sweep never touches dirs this manager handed out."""
+        root = str(tmp_path / "tmp")
+        os.makedirs(os.path.join(root, "publish-7-dead"))
+        os.makedirs(os.path.join(root, "catchup-beef"))
+        mgr = TmpDirManager(root)
+        assert mgr.reaped_at_boot == 2
+        assert os.listdir(root) == []
+        live = mgr.tmp_dir("publish-8")
+        os.makedirs(os.path.join(root, "publish-9-orphan"))
+        assert mgr.reap_orphans() == 1  # the orphan, never the live dir
+        assert os.path.isdir(live.get_name())
+        assert not os.path.exists(os.path.join(root, "publish-9-orphan"))
+
 
 class TestConfigStreamsKnob:
     def test_sig_verify_streams_validation(self):
