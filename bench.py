@@ -746,6 +746,19 @@ def bench_scenario_liveness(matrix="small", only=None, seed=1):
             "invariant_violations": sb.invariant_violations,
             "digest": sb.digest(),
         }
+        # time-and-asymmetry plane observables (ISSUE r19): closeTime-
+        # gate rejections for the skew classes, per-tier aggregates for
+        # the targeted/tiered shapes — emitted only on lines where they
+        # carry signal, to keep the other class lines lean.  (The
+        # embedded digest() still evolves across versions — it gained
+        # the slip counters like it gained sendq_sheds in r17; its
+        # contract is two-run equality within a version, not
+        # cross-version byte-stability.)
+        slip = sb.slip_rejects_past + sb.slip_rejects_future
+        if slip:
+            out[sb.fault_class]["slip_rejects"] = slip
+        if sb.per_tier:
+            out[sb.fault_class]["per_tier"] = sb.per_tier
         if not r.ok:
             out[sb.fault_class]["failures"] = r.failures
     return out

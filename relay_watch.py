@@ -184,6 +184,24 @@ FINAL_STEPS = [
      [sys.executable, "-u", "-m", "stellar_tpu.scenarios",
       "--kill-sweep", "--json"],
      1200),
+    # r19: time-and-asymmetry plane — the big-matrix skew / one-way /
+    # targeted-tier legs plus the 100-node core-and-tier OVER_TCP scale
+    # shape (tcp_scale is big-only: real localhost sockets, 4-core
+    # committee + 96 relaying watchers, >=5 ledgers per node).  Exits 1
+    # on any floor miss: a within-slip skew metering a closeTime
+    # rejection, a beyond-slip skew NOT metering one (or the skewed
+    # node failing to rejoin inside the recovery budget), the one-way
+    # partition missing its recovery-ms floor, the targeted flood
+    # disturbing tier-1 or shedding CRITICAL anywhere, or the TCP shape
+    # failing to externalize at scale.
+    ("chaos_asymmetry_r19",
+     [sys.executable, "-u", "-m", "stellar_tpu.scenarios",
+      "--matrix", "big",
+      "--only", "clock_skew_within_slip,clock_skew_beyond_slip,"
+      "asymmetric_partition,targeted_flood_tier2,byzantine_flood_tpu,"
+      "tcp_scale",
+      "--json"],
+     1800),
 ]
 ALL_NAMES = (
     [s[0] for s in SCRIPT_STEPS]

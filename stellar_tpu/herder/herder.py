@@ -22,6 +22,7 @@ from typing import Dict, List, Optional
 
 from ..crypto import PubKeyUtils, sha256
 from ..scp import SCP, SCPDriver
+from ..scp.quorum import iter_all_nodes
 from ..scp.quorum import qset_hash as compute_qset_hash
 from ..scp.slot import Slot
 from ..util import VirtualTimer, fs, xlog
@@ -186,6 +187,30 @@ class Herder(SCPDriver):
         self.m_envelope_emit = m.new_meter(("scp", "envelope", "emit"), "envelope")
         self.m_value_valid = m.new_meter(("scp", "value", "valid"), "value")
         self.m_value_invalid = m.new_meter(("scp", "value", "invalid"), "value")
+        # time-slip rejections (ISSUE r19 satellite): the closeTime gates
+        # in _validate_value_helper used to drop too-old/too-future values
+        # SILENTLY — under inter-node clock skew these meters are the only
+        # observable telling an operator "my clock disagrees with the
+        # quorum" apart from unexplained liveness loss.  Surfaced in
+        # dump_info and digested by the chaos scoreboard's skew classes.
+        self.m_value_close_past = m.new_meter(
+            ("herder", "value", "reject-closetime-past"), "value"
+        )
+        self.m_value_close_future = m.new_meter(
+            ("herder", "value", "reject-closetime-future"), "value"
+        )
+        # stalled-while-tracking SCP-state probes (ISSUE r19): how often
+        # this node, seeing signed evidence the quorum moved on without
+        # it, asked its peers to replay their recent SCP state
+        self.m_scp_state_probe = m.new_meter(
+            ("herder", "scp-state", "probe"), "probe"
+        )
+        # stall-probe bookkeeping (see _note_quorum_ahead): last local
+        # consensus progress and last probe, on the app clock; the
+        # quorum-member set is cached keyed by local qset hash
+        self._last_progress_at = app.clock.now()
+        self._last_probe_at = float("-inf")
+        self._quorum_members: Optional[tuple] = None
         self.m_value_externalize = m.new_meter(("scp", "value", "externalize"), "value")
         self.m_quorum_heard = m.new_meter(("scp", "quorum", "heard"), "quorum")
         self.m_lost_sync = m.new_meter(("scp", "sync", "lost"), "sync")
@@ -237,6 +262,7 @@ class Herder(SCPDriver):
         assert self.scp.is_validator
         lcl = self.ledger_manager.get_last_closed_ledger_header()
         self.tracking = ConsensusData(lcl.header.ledgerSeq, lcl.header.scpValue)
+        self._last_progress_at = self.app.clock.now()
         self._tracking_heartbeat()
         self.last_trigger = self.app.clock.now() - EXP_LEDGER_TIMESPAN_SECONDS
         self.ledger_closed()
@@ -332,8 +358,10 @@ class Herder(SCPDriver):
             last_close_time = self.tracking.value.closeTime
 
         if sv.closeTime <= last_close_time:
+            self.m_value_close_past.mark()
             return False
         if sv.closeTime > self.app.time_now() + MAX_TIME_SLIP_SECONDS:
+            self.m_value_close_future.mark()
             return False
         if not compat:
             return True
@@ -587,6 +615,7 @@ class Herder(SCPDriver):
 
         self.current_value = b""
         self.tracking = ConsensusData(slot_index, sv)
+        self._last_progress_at = self.app.clock.now()
         self._tracking_heartbeat()
 
         externalized_set = self.pending_envelopes.get_tx_set(sv.txSetHash)
@@ -763,6 +792,26 @@ class Herder(SCPDriver):
         meter = self.m_envelope_type.get(stype)
         if meter is not None:
             meter.mark()
+        # stalled-while-tracking recovery (ISSUE r19): a signed envelope
+        # for a FUTURE slot from a node IN OUR TRANSITIVE QUORUM is
+        # evidence the quorum externalized slots we never closed.  A
+        # node that stalls WITHOUT losing its connections — one-way
+        # partition (it hears nothing but is heard), beyond-slip clock
+        # skew (it hears everything and rejects it) — never gets the
+        # on-connect SCP-state replay that heals a reconnecting node,
+        # and pre-r19 its only way back was a full history-archive
+        # catchup once the gap outgrew MAX_SLOTS_TO_REMEMBER.  Probe
+        # instead: ask peers to replay their recent state while the gap
+        # is still inside the window.  The membership gate keeps an
+        # unprivileged valid-sig key from repeatedly wiping the flood
+        # dedup + triggering GET_SCP_STATE amplification on a merely
+        # slow (not left-behind) node.
+        if (
+            self.tracking
+            and slot > self.next_consensus_ledger_index()
+            and self._in_transitive_quorum(envelope.statement.nodeID)
+        ):
+            self._note_quorum_ahead()
         bucket = self.scp_slot_buckets.get(slot)
         if bucket is None:
             make = True
@@ -805,6 +854,70 @@ class Herder(SCPDriver):
                 return s
             heapq.heappop(heap)
         return None
+
+    def _in_transitive_quorum(self, node_id) -> bool:
+        """Is ``node_id`` mentioned anywhere in our (nested) local quorum
+        set?  Cached keyed by the local qset hash so the walk happens
+        once per qset, not per envelope."""
+        qh = self.scp.local_qset_hash
+        cached = self._quorum_members
+        if cached is None or cached[0] != qh:
+            members = frozenset(
+                n.value for n in iter_all_nodes(self.scp.local_qset)
+            )
+            self._quorum_members = cached = (qh, members)
+        return node_id.value in cached[1]
+
+    def _trigger_cadence(self) -> float:
+        """The expected seconds between closes on this node's config."""
+        if self.app.config.ARTIFICIALLY_ACCELERATE_TIME_FOR_TESTING:
+            return 1.0
+        return float(EXP_LEDGER_TIMESPAN_SECONDS)
+
+    def _note_quorum_ahead(self) -> None:
+        """Signed evidence arrived that the quorum is past our next slot.
+        If we have made no local progress for two close cadences, the
+        quorum externalized without us — rate-limited to one probe per
+        cadence, ask every authenticated peer for its recent SCP state
+        (GET_SCP_STATE 0 → send_scp_state_to_peer replays max-3..max),
+        the same ≤MAX_SLOTS_TO_REMEMBER replay a reconnecting peer gets
+        at AUTH.  Before probing, the pending-envelope plane forgets the
+        gap slots: envelopes we already handed to SCP may have been
+        rejected under conditions that no longer hold (a healed clock
+        re-validates the same closeTime), and the replies re-deliver the
+        identical packed bytes the processed-dedup would otherwise
+        swallow."""
+        now = self.app.clock.now()
+        cadence = self._trigger_cadence()
+        if now - self._last_progress_at < 2 * cadence:
+            return
+        if now - self._last_probe_at < cadence:
+            return
+        om = self.app.overlay_manager
+        if om is None:
+            return
+        peers = om.authenticated_peers()
+        if not peers:
+            return
+        self._last_probe_at = now
+        self.pending_envelopes.forget_above(
+            self.last_consensus_ledger_index()
+        )
+        # ...and the overlay's at-most-once flood memory for the same
+        # window: the replies re-deliver packed-identical messages the
+        # floodgate would otherwise drop before the herder sees them
+        om.floodgate.forget_from(self.next_consensus_ledger_index())
+        self.m_scp_state_probe.mark()
+        log.info(
+            "quorum ahead of slot %d with no local progress: probing %d"
+            " peer(s) for recent SCP state",
+            self.next_consensus_ledger_index(),
+            len(peers),
+        )
+        for peer in peers:
+            peer.send_message(
+                StellarMessage(MessageType.GET_SCP_STATE, 0)
+            )
 
     def note_envelope_rejected(self, envelope: SCPEnvelope) -> None:
         """The overlay's batch flush verified this envelope's signature
@@ -1065,4 +1178,9 @@ class Herder(SCPDriver):
             "slot_buckets": {
                 s: dict(v) for s, v in self.scp_slot_buckets.items()
             },
+            "closetime_rejects": {
+                "past": self.m_value_close_past.count,
+                "future": self.m_value_close_future.count,
+            },
+            "scp_state_probes": self.m_scp_state_probe.count,
         }

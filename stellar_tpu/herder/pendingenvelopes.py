@@ -243,6 +243,20 @@ class PendingEnvelopes:
             for s in [s for s in d if s < slot_index]:
                 del d[s]
 
+    def forget_above(self, slot_index: int) -> None:
+        """Forget the PROCESSED memory for every slot past ``slot_index``
+        (the herder's stall probe, ISSUE r19): envelopes already handed
+        to SCP may have been value-rejected under local conditions that
+        no longer hold (a healed clock), and the probe's replies carry
+        the IDENTICAL packed bytes — without this the processed-dedup
+        would swallow the replay.  Re-processing is safe: SCP statement
+        handling is idempotent and the floodgate dedups the relay.
+        ``fetching`` keeps its entries (still waiting on dependencies);
+        ``pending`` keeps its queue (duplicates just re-feed SCP the
+        same statement)."""
+        for s in [s for s in self.processed if s > slot_index]:
+            del self.processed[s]
+
     def slot_closed(self, slot_index: int) -> None:
         """Drop all state at or below the closed slot (keep newer)."""
         self.erase_below(slot_index + 1)

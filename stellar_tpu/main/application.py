@@ -111,6 +111,15 @@ class Application:
         self.process_manager = None
         # boot self-check report (main/selfcheck.py), served on /selfcheck
         self.last_selfcheck: Optional[dict] = None
+        # per-node wall-clock skew seam (chaos plane, ISSUE r19): maps the
+        # shared clock's reading to THIS node's offset in seconds, so a
+        # multi-node simulation can model clock skew/drift/NTP-jumps per
+        # validator while every timer still rides the one shared clock.
+        # None = no skew (production, and every node by default).  Only
+        # time_now() — the WALL-time view (closeTime nomination, the
+        # MAX_TIME_SLIP_SECONDS gate) — consults it; durations and timer
+        # deadlines are clock-relative and must not skew.
+        self.clock_offset_fn = None
 
         if new_db or (auto_init and self._needs_initialization()):
             # offline utility modes (--info/--loadxdr) pass auto_init=False:
@@ -218,8 +227,16 @@ class Application:
         self.database.close()
 
     def time_now(self) -> int:
-        """Current time as unix seconds on this app's clock (Application::timeNow)."""
-        return int(self.clock.now())
+        """Current time as unix seconds on this app's clock
+        (Application::timeNow), through the per-node skew seam: a
+        simulation-installed ``clock_offset_fn`` shifts THIS node's
+        wall-time view (closeTime proposals, the MAX_TIME_SLIP_SECONDS
+        acceptance gate) without touching the shared clock's timers."""
+        now = self.clock.now()
+        off = self.clock_offset_fn
+        if off is not None:
+            now += off(now)
+        return int(now)
 
     # -- cross-subsystem notifications -------------------------------------
     def herder_notify_ledger_closed(self) -> None:

@@ -51,6 +51,21 @@ class Floodgate:
             del self.flood_map[k]
         self.m_added.set_count(len(self.flood_map))
 
+    def forget_from(self, ledger_seq: int) -> None:
+        """Forget records stamped at or after ``ledger_seq`` — the
+        herder's stall probe (ISSUE r19): a node stalled while tracking
+        accumulated at-most-once records for exactly the slots it failed
+        to close, and the probe's SCP-state replay re-delivers those
+        same messages — without this the dedup swallows them before the
+        herder ever sees the retry.  Cost is bounded re-flood chatter
+        for the forgotten window (receivers still dedup), paid only at
+        the probe's own rate limit."""
+        for k in [
+            k for k, r in self.flood_map.items() if r.ledger_seq >= ledger_seq
+        ]:
+            del self.flood_map[k]
+        self.m_added.set_count(len(self.flood_map))
+
     def add_record(self, msg: StellarMessage, from_peer) -> bool:
         """Returns True if the message is NEW (should be processed/forwarded)."""
         if self._shutting_down:

@@ -121,6 +121,15 @@ class Peer:
         # drains into the transport in class order, OVERLAY_SENDQ_BYTES=0
         # degenerates to the reference's immediate unbounded sends
         self.send_queue = SendQueue(self)
+        # one-way fault seam (chaos plane, ISSUE r19): True silently drops
+        # every outbound message at the send choke point, BEFORE it enters
+        # the queue or consumes a MAC sequence number — the half-open-
+        # connection model.  The reverse direction keeps delivering with
+        # valid MACs, and clearing the flag resumes THIS direction on the
+        # same connection with the sequence intact (no flap): dropping any
+        # later (post-queue or post-sequencing) would open a MAC-sequence
+        # gap and cost the connection on heal.
+        self.outbound_blackhole = False
         self._start_idle_timer()
 
     def io_timeout_seconds(self) -> int:
@@ -256,6 +265,8 @@ class Peer:
         flood fan-out passes ONE shared buffer to every peer."""
         if self.should_abort() and msg.type != MessageType.ERROR_MSG:
             return
+        if self.outbound_blackhole:
+            return  # one-way fault: the frame vanishes pre-queue, pre-seq
         # the sent-message meter and bytes_send both mark at the queue's
         # DRAIN (sendqueue._emit) — a shed frame never counted as sent
         self.send_queue.enqueue(msg, body)

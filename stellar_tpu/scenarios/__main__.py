@@ -108,7 +108,14 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             return 2
 
-    results = run_matrix(matrix=args.matrix, only=only, seed=args.seed)
+    try:
+        results = run_matrix(matrix=args.matrix, only=only, seed=args.seed)
+    except ValueError as e:
+        # run_matrix raises for classes absent from the chosen matrix
+        # (big-only shapes like tcp_scale) — a silently-empty run must
+        # not read as a green matrix
+        print(str(e), file=sys.stderr)
+        return 2
     any_fail = False
     for r in results:
         if args.as_json:
@@ -117,7 +124,7 @@ def main(argv=None) -> int:
             sb = r.scoreboard
             print(
                 "%-24s %-4s ledgers=%d (%.2f/s) nom=%d ballot=%d "
-                "rejects=%d recovery=%s inv=%d digest=%s"
+                "rejects=%d slip=%d recovery=%s inv=%d digest=%s"
                 % (
                     r.name,
                     "ok" if r.ok else "FAIL",
@@ -126,6 +133,7 @@ def main(argv=None) -> int:
                     sb.nomination_rounds,
                     sb.ballot_rounds,
                     sb.fast_rejects,
+                    sb.slip_rejects_past + sb.slip_rejects_future,
                     ("%.0fms" % sb.recovery_ms)
                     if sb.recovery_ms is not None
                     else "-",

@@ -55,6 +55,47 @@ class Fault:
         t.expires_from_now(max(0.0, delay))
         t.async_wait(fn)
 
+    # shared engage→heal scaffold for faults with a lag-polled heal
+    # (Partition, ClockSkew, AsymmetricPartition): arm ``engage_fn`` at
+    # ``at``; heal at the ``heal_at`` deadline or as soon as the fastest
+    # node is ``heal_lag`` ledgers past the slowest — whichever first,
+    # at most once.  ``heal_fn(reason)`` performs the class-specific
+    # undo + note; the scaffold owns the once-only sentinel, the
+    # recovery-clock stamp, and the poll rescheduling.
+    def _arm_engage_heal(
+        self, scn, engage_fn, heal_fn, *,
+        at: float,
+        heal_at: Optional[float] = None,
+        heal_lag: Optional[int] = None,
+        poll: float = 0.25,
+    ) -> None:
+        healed = []
+
+        def heal(reason):
+            if healed or scn.done:
+                return
+            healed.append(True)
+            heal_fn(reason)
+            scn.mark_recovery_start()
+
+        def poll_lag():
+            if healed or scn.done:
+                return
+            lcls = scn.sim.ledger_nums()
+            if lcls and max(lcls) - min(lcls) >= heal_lag:
+                heal("lag=%d" % (max(lcls) - min(lcls)))
+            else:
+                self._at(scn, poll, poll_lag, slot="poll")
+
+        def engage():
+            engage_fn()
+            if heal_lag is not None:
+                self._at(scn, poll, poll_lag, slot="poll")
+
+        self._at(scn, at, engage)
+        if heal_at is not None:
+            self._at(scn, heal_at, lambda: heal("deadline"))
+
 
 @dataclass
 class Partition(Fault):
@@ -78,35 +119,135 @@ class Partition(Fault):
     poll: float = 0.25
 
     def arm(self, scn) -> None:
-        healed = []
-
         def split():
             keys = [[scn.node_keys[i] for i in g] for g in self.groups]
             scn.sim.partition(*keys)
             scn.note("partition at t=%.1f: %s" % (scn.elapsed(), self.groups))
-            if self.heal_lag is not None:
-                self._at(scn, self.poll, poll_lag, slot='poll')
 
         def heal(reason):
-            if healed or scn.done:
-                return
-            healed.append(True)
             scn.sim.heal()
-            scn.mark_recovery_start()
             scn.note("heal at t=%.1f (%s)" % (scn.elapsed(), reason))
 
-        def poll_lag():
-            if healed or scn.done:
-                return
-            lcls = scn.sim.ledger_nums()
-            if lcls and max(lcls) - min(lcls) >= self.heal_lag:
-                heal("lag=%d" % (max(lcls) - min(lcls)))
-            else:
-                self._at(scn, self.poll, poll_lag, slot='poll')
+        self._arm_engage_heal(
+            scn, split, heal, at=self.at, heal_at=self.heal_at,
+            heal_lag=self.heal_lag, poll=self.poll,
+        )
 
-        self._at(scn, self.at, split)
-        if self.heal_at is not None:
-            self._at(scn, self.heal_at, lambda: heal("deadline"))
+
+@dataclass
+class ClockSkew(Fault):
+    """Per-node wall-clock skew (ISSUE r19): at ``at``, node ``node``'s
+    ``Application.time_now`` view diverges from the shared clock through
+    the Simulation's clock-offset seam — closeTime nomination and the
+    MAX_TIME_SLIP_SECONDS acceptance gate see the skewed time, while
+    every timer still rides the one shared clock.  Three schedules,
+    composable and all pure functions of the shared virtual clock (the
+    determinism contract — no wall reads, no RNG):
+
+    - static:  ``offset`` seconds from the moment the fault engages;
+    - drift:   ``drift_per_sec`` grows the offset linearly from engage
+               (the cheap-oscillator shape);
+    - step:    with ``step_at`` set, the static ``offset`` lands as a
+               JUMP that many seconds after engage (the NTP-step shape;
+               drift, if any, still accrues from engage).
+
+    ``heal_at`` (deadline) / ``heal_lag`` (heal as soon as the fastest
+    node is ``heal_lag`` ledgers past the slowest — the replayable-lag
+    shape, like Partition) clear the offset and stamp the recovery
+    clock.  A skew beyond MAX_TIME_SLIP_SECONDS makes the skewed node
+    reject the quorum's values (metered as
+    herder.value.reject-closetime-future) or the quorum reject the
+    skewed node's — either way consensus must ride it out and the
+    skewed node must rejoin once the skew heals."""
+
+    at: float
+    node: int
+    offset: float = 0.0
+    drift_per_sec: float = 0.0
+    step_at: Optional[float] = None
+    heal_at: Optional[float] = None
+    heal_lag: Optional[int] = None
+    poll: float = 0.25
+
+    def arm(self, scn) -> None:
+        key = scn.node_keys[self.node]
+
+        def engage():
+            t0 = scn.sim.clock.now()
+            step_t = None if self.step_at is None else t0 + self.step_at
+            static, drift = self.offset, self.drift_per_sec
+
+            def offset_fn(now: float) -> float:
+                off = drift * (now - t0)
+                if step_t is None or now >= step_t:
+                    off += static
+                return off
+
+            scn.sim.set_clock_offset(key, offset_fn)
+            scn.note(
+                "clock skew on node %d at t=%.1f: offset=%+.1fs"
+                " drift=%+.3f/s step_at=%s"
+                % (self.node, scn.elapsed(), static, drift, self.step_at)
+            )
+
+        def heal(reason):
+            scn.sim.clear_clock_offset(key)
+            scn.note(
+                "clock skew on node %d healed at t=%.1f (%s)"
+                % (self.node, scn.elapsed(), reason)
+            )
+
+        self._arm_engage_heal(
+            scn, engage, heal, at=self.at, heal_at=self.heal_at,
+            heal_lag=self.heal_lag, poll=self.poll,
+        )
+
+
+@dataclass
+class AsymmetricPartition(Fault):
+    """One-way isolation (ISSUE r19): at ``at``, frames TOWARD the
+    ``deaf`` nodes are silently dropped while their own frames keep
+    flowing — ``Simulation.partition(deaf, rest, oneway=True)``, the
+    half-open-connection case.  Links stay up and authenticated the
+    whole time (the drop happens before the MAC/sequence plane), so the
+    deaf node keeps voting into a network it can no longer hear; heal
+    resumes delivery on the SAME connections (no flap) and the deaf
+    node replays the missed slots from peers' SCP state rebroadcast.
+    ``heal_lag`` (with ``heal_at`` as deadline backstop) keeps the lag
+    inside the replayable SCP window, like Partition."""
+
+    at: float
+    deaf: List[int]
+    heal_at: Optional[float] = None
+    heal_lag: Optional[int] = None
+    poll: float = 0.25
+
+    def arm(self, scn) -> None:
+        deaf_keys = [scn.node_keys[i] for i in self.deaf]
+        rest = [
+            k for i, k in enumerate(scn.node_keys) if i not in self.deaf
+        ]
+
+        def split():
+            # group0→group1 delivered, group1→group0 dropped: the deaf
+            # nodes are heard (group0 = deaf) but hear nothing back
+            scn.sim.partition(deaf_keys, rest, oneway=True)
+            scn.note(
+                "one-way partition at t=%.1f: nodes %s deaf"
+                % (scn.elapsed(), self.deaf)
+            )
+
+        def heal(reason):
+            scn.sim.heal()
+            scn.note(
+                "one-way partition healed at t=%.1f (%s)"
+                % (scn.elapsed(), reason)
+            )
+
+        self._arm_engage_heal(
+            scn, split, heal, at=self.at, heal_at=self.heal_at,
+            heal_lag=self.heal_lag, poll=self.poll,
+        )
 
 
 @dataclass
@@ -295,6 +436,11 @@ class ByzantineFlood(Fault):
     at: float
     until: float
     target: int = 0
+    # targeted flood (ISSUE r19): inject into EVERY node listed instead
+    # of the single `target` — the tier-scoped flood shape (aim only at
+    # tier-2 validators and assert tier-1's floor is undisturbed).  The
+    # per-tick volumes apply PER TARGET.  None = [target].
+    targets: Optional[List[int]] = None
     envelopes_per_tick: int = 25
     txs_per_tick: int = 5
     tick: float = 0.5
@@ -336,10 +482,12 @@ class ByzantineFlood(Fault):
             SCPStatementType,
         )
 
-        app = scn.sim.nodes[scn.sim._raw_key(scn.node_keys[self.target])]
+        app = scn.sim.nodes[
+            scn.sim._raw_key(scn.node_keys[self._target_indices()[0]])
+        ]
         qset_hash = app.herder.scp.local_qset_hash
         n_ticks = int((self.until - self.at) / self.tick) + 2
-        n = self.storm_per_tick * n_ticks
+        n = self.storm_per_tick * n_ticks * len(self._target_indices())
         base = 50_000_000 + (scn.spec.seed % 1000) * 100_000
         committee = [
             SecretKey.pseudo_random_for_testing(base + i)
@@ -373,14 +521,17 @@ class ByzantineFlood(Fault):
                 )
             )
 
+    def _target_indices(self) -> List[int]:
+        return self.targets if self.targets is not None else [self.target]
+
     # -- injection ----------------------------------------------------------
     def _tick_fn(self, scn) -> None:
         if scn.elapsed_since_arm() >= self.until or scn.done:
             return
-        app = scn.sim.nodes.get(
-            scn.sim._raw_key(scn.node_keys[self.target])
-        )
-        if app is not None:
+        for idx in self._target_indices():
+            app = scn.sim.nodes.get(scn.sim._raw_key(scn.node_keys[idx]))
+            if app is None:
+                continue
             for _ in range(self.envelopes_per_tick):
                 self._inject_envelope(app)
             for _ in range(self.txs_per_tick):
@@ -569,27 +720,55 @@ class OverloadStorm(Fault):
     msgs_per_tick: int = 30
     tick: float = 0.25
     drain_bytes_per_sec: float = 16384.0
+    # targeted overload (ISSUE r19): cap only the links TOUCHING these
+    # node indices instead of every link — the tier-scoped storm (tier-2
+    # links saturate and shed; tier-1's core links stay clean, so its
+    # consensus floor is the undisturbed one).  None = every link.
+    drain_nodes: Optional[List[int]] = None
 
     def __post_init__(self):
         self.n_storm = 0
         self._pool: List = []
+
+    def _capped_links(self, scn) -> Optional[List[tuple]]:
+        if self.drain_nodes is None:
+            return None
+        raws = {scn.sim._raw_key(scn.node_keys[i]) for i in self.drain_nodes}
+        return [
+            (ia, ib) for (ia, ib) in scn.sim.links if raws & {ia, ib}
+        ]
 
     def arm(self, scn) -> None:
         self._rng = random.Random(scn.spec.seed ^ 0x570A4)
         self._build_pool(scn)
 
         def degrade():
-            scn.sim.set_link_faults(
-                FaultProfile(drain=self.drain_bytes_per_sec)
-            )
+            links = self._capped_links(scn)
+            profile = FaultProfile(drain=self.drain_bytes_per_sec)
+            if links is None:
+                scn.sim.set_link_faults(profile)
+            else:
+                for ia, ib in links:
+                    scn.sim.set_link_faults(profile, ia, ib)
             scn.note(
-                "overload storm: all links drain at %d B/s, %d tx/tick"
-                % (self.drain_bytes_per_sec, self.msgs_per_tick)
+                "overload storm: %s drain at %d B/s, %d tx/tick"
+                % (
+                    "all links"
+                    if links is None
+                    else "%d links @ nodes %s" % (len(links), self.drain_nodes),
+                    self.drain_bytes_per_sec,
+                    self.msgs_per_tick,
+                )
             )
             self._tick_fn(scn)
 
         def restore():
-            scn.sim.set_link_faults(FaultProfile())
+            links = self._capped_links(scn)
+            if links is None:
+                scn.sim.set_link_faults(FaultProfile())
+            else:
+                for ia, ib in links:
+                    scn.sim.set_link_faults(FaultProfile(), ia, ib)
             scn.sim.ensure_links()
             scn.note("overload storm over at t=%.1f" % scn.elapsed())
 

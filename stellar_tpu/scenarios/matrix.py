@@ -43,6 +43,44 @@ Fault classes (ROADMAP #5 / ISSUE r12 acceptance):
                           across all links: FLOOD sheds at every queue,
                           CRITICAL jumps them, queue-byte high-water stays
                           under OVERLAY_SENDQ_BYTES, liveness floor holds
+- ``clock_skew_within_slip`` — per-node clock offsets INSIDE the
+                          MAX_TIME_SLIP_SECONDS acceptance window (static
+                          +30s on one node, slow drift on another): the
+                          closeTime gates must stay silent (0 metered
+                          rejections) and the consensus floor must hold —
+                          the tolerance the protocol promises
+- ``clock_skew_beyond_slip`` — an NTP-step skew BEYOND the slip window:
+                          the skewed node rejects the quorum's values
+                          (herder.value.reject-closetime-future metered,
+                          ≥1 asserted) and stalls while the unskewed
+                          majority keeps its floor; when the skew heals
+                          (lag-polled, inside the SCP replay window) the
+                          node replays the missed slots and recovery is
+                          measured against a floor
+- ``asymmetric_partition`` — ONE-WAY isolation of a tier-1 node (frames
+                          toward it dropped pre-MAC, its own frames keep
+                          flowing — the half-open connection the
+                          symmetric groups API cannot express): links
+                          never flap, the deaf node stalls, heal resumes
+                          the same connections and recovery is measured
+- ``targeted_flood_tier2`` — byzantine flood + drain-capped overload
+                          storm aimed ONLY at tier-2 nodes of a
+                          core-and-tier ring: tier-1 holds its
+                          undisturbed floor, tier-2 sheds FLOOD through
+                          the r17 send queues, 0 CRITICAL sheds anywhere
+                          (per-tier scoreboard aggregates carry the
+                          verdict)
+- ``byzantine_flood_tpu`` — the byzantine flood with the DEVICE batch
+                          plane engaged (SIGNATURE_BACKEND="tpu",
+                          cutover 0): every overlay flush rides the
+                          verify kernel; tier-1 runs the XLA-CPU oracle
+                          and the CALLER_OVERLAY wedge-latch contract is
+                          pinned under flood
+- ``tcp_scale``         — the 100+ node core-and-tier shape OVER REAL
+                          TCP SOCKETS (big matrix / -m slow only): the
+                          sendqueue + pack-once fan-out planes at
+                          production-transport scale, ≥5 ledgers
+                          externalized with per-tier aggregates
 """
 
 from __future__ import annotations
@@ -51,7 +89,9 @@ from typing import Dict, List, Optional
 
 from ..overlay.loopback import FaultProfile
 from .faults import (
+    AsymmetricPartition,
     ByzantineFlood,
+    ClockSkew,
     CrashRestart,
     HardKillMidClose,
     OverloadStorm,
@@ -66,12 +106,18 @@ FAULT_CLASSES = (
     "partition_heal",
     "byzantine_flood",
     "byzantine_flood_halfagg",
+    "byzantine_flood_tpu",
     "slow_lossy",
     "crash_restart",
     "hard_kill_mid_close",
     "catchup_load",
     "slow_reader",
     "overload_storm",
+    "clock_skew_within_slip",
+    "clock_skew_beyond_slip",
+    "asymmetric_partition",
+    "targeted_flood_tier2",
+    "tcp_scale",
 )
 
 
@@ -257,6 +303,140 @@ def small_specs(seed: int = 1) -> Dict[str, ScenarioSpec]:
             min_ledgers_per_sec=0.2,
             timeout=240.0,
         ),
+        # the time-and-asymmetry plane (ISSUE r19).  Within-slip: one
+        # node statically +30s ahead (half the 60s MAX_TIME_SLIP window)
+        # and another drifting at +20ms/s — tolerable skew the protocol
+        # promises to absorb: the closeTime gates must meter NOTHING and
+        # the floor is the undisturbed one.
+        "clock_skew_within_slip": ScenarioSpec(
+            name="clock_skew_within_slip_small",
+            fault_class="clock_skew_within_slip",
+            n_nodes=3,
+            threshold=2,
+            seed=seed,
+            faults=[
+                ClockSkew(at=0.5, node=2, offset=30.0),
+                ClockSkew(at=0.5, node=1, drift_per_sec=0.02),
+            ],
+            max_slip_rejects=0,
+            target_ledgers=14,
+            min_ledgers_per_sec=0.5,
+            timeout=180.0,
+        ),
+        # Beyond-slip: node 2's clock NTP-steps 90s BEHIND shortly after
+        # the window opens, so every honest value reads >60s in the
+        # future through its skewed gate — it stalls, metering
+        # reject-closetime-future, while the 2-of-3 majority keeps its
+        # floor.  The lag-polled heal (inside the SCP replay window)
+        # models the operator fixing NTP; the node must replay the
+        # missed slots and the recovery clock has a floor.
+        "clock_skew_beyond_slip": ScenarioSpec(
+            name="clock_skew_beyond_slip_small",
+            fault_class="clock_skew_beyond_slip",
+            n_nodes=3,
+            threshold=2,
+            seed=seed,
+            faults=[
+                ClockSkew(
+                    at=0.5, node=2, offset=-90.0, step_at=0.5,
+                    heal_lag=3, heal_at=12.0,
+                )
+            ],
+            load_backlog_ledgers=2,
+            min_slip_rejects=1,
+            target_ledgers=14,
+            min_ledgers_per_sec=0.5,
+            max_recovery_ms=15_000,
+            timeout=180.0,
+        ),
+        # One-way isolation of a tier-1 node: node 2 is heard but hears
+        # nothing (rest→2 dropped pre-MAC; 2→rest delivered) — the
+        # half-open-connection case.  Links stay up the whole time; the
+        # deaf node keeps voting into the void, stalls, and after the
+        # lag-polled heal replays the missed slots from the still-open
+        # connections' SCP rebroadcast.
+        "asymmetric_partition": ScenarioSpec(
+            name="asymmetric_partition_small",
+            fault_class="asymmetric_partition",
+            n_nodes=3,
+            threshold=2,
+            seed=seed,
+            faults=[
+                AsymmetricPartition(
+                    at=0.5, deaf=[2], heal_lag=3, heal_at=12.0
+                )
+            ],
+            load_backlog_ledgers=2,
+            target_ledgers=14,
+            min_ledgers_per_sec=0.2,
+            max_recovery_ms=15_000,
+            timeout=180.0,
+        ),
+        # Targeted tier flood: invalid-sig envelope/tx flood injected
+        # ONLY into the tier-2 ring nodes, plus a drain-capped overload
+        # storm broadcast from a tier node across tier-touching links
+        # only.  Tier-1's core mesh is untouched — its floor is the
+        # UNDISTURBED one (vs the 0.2 global floors above) — while
+        # tier-2 sheds FLOOD through the r17 send queues; per-tier
+        # aggregates carry the verdict, and 0 CRITICAL sheds anywhere.
+        "targeted_flood_tier2": ScenarioSpec(
+            name="targeted_flood_tier2_small",
+            fault_class="targeted_flood_tier2",
+            topology="core_and_tier",
+            n_nodes=3,
+            tier_n=2,
+            seed=seed,
+            sendq_bytes=32 * 1024,
+            sendq_flood_msgs=48,
+            straggler_stall_ms=2500,
+            faults=[
+                ByzantineFlood(
+                    at=0.5, until=8.0, targets=[3, 4],
+                    envelopes_per_tick=15, txs_per_tick=3, tick=0.4,
+                ),
+                OverloadStorm(
+                    at=0.5, until=8.0, source=3,
+                    msgs_per_tick=25, tick=0.25,
+                    drain_bytes_per_sec=16384,
+                    drain_nodes=[3, 4],
+                ),
+            ],
+            load_accounts=4,
+            load_txs=120,
+            load_rate=15,
+            tiers={"tier1": [0, 1, 2], "tier2": [3, 4]},
+            liveness_exclude=[3, 4],
+            min_flood_sheds=1,
+            assert_high_water_bounded=True,
+            target_ledgers=14,
+            min_ledgers_per_sec=0.5,
+            timeout=240.0,
+        ),
+        # The tpu-backend flood leg (ROADMAP 6(a)): the byzantine flood
+        # with the DEVICE batch plane engaged — SIGNATURE_BACKEND="tpu"
+        # with cutover 0 routes every overlay flush (honest + flood)
+        # through BatchVerifier's device dispatch; in tier-1 the
+        # "device" is the XLA-CPU oracle.  The test pins the
+        # CALLER_OVERLAY wedge-latch contract: zero wedge fallbacks and
+        # zero latch flips under flood, verdicts identical to the cpu
+        # path (same floors, same cache-cleanliness oracle).
+        "byzantine_flood_tpu": ScenarioSpec(
+            name="byzantine_flood_tpu_small",
+            fault_class="byzantine_flood_tpu",
+            n_nodes=3,
+            seed=seed,
+            signature_backend="tpu",
+            tpu_cpu_cutover=0,
+            faults=[
+                ByzantineFlood(
+                    at=0.5, until=7.0, target=0,
+                    envelopes_per_tick=25, txs_per_tick=5, tick=0.4,
+                )
+            ],
+            target_ledgers=14,
+            min_ledgers_per_sec=0.2,
+            timeout=180.0,
+        ),
         "catchup_load": ScenarioSpec(
             name="catchup_load_small",
             fault_class="catchup_load",
@@ -284,7 +464,8 @@ def small_specs(seed: int = 1) -> Dict[str, ScenarioSpec]:
 
 def big_specs(seed: int = 1) -> Dict[str, ScenarioSpec]:
     """Core-and-tier ring scale (-m slow / scenario_liveness_r12 --matrix
-    big): 4-core + 4-tier ring, longer fault windows, bigger floods."""
+    big): 4-core + 4-tier ring, longer fault windows, bigger floods —
+    plus the big-only ``tcp_scale`` 100+ node OVER_TCP shape."""
     small = small_specs(seed)
     out: Dict[str, ScenarioSpec] = {}
     for cls, spec in small.items():
@@ -357,7 +538,69 @@ def big_specs(seed: int = 1) -> Dict[str, ScenarioSpec]:
                 )
             ]
             big.load_txs = 300
+        elif cls == "byzantine_flood_tpu":
+            big.faults = [
+                ByzantineFlood(
+                    at=0.5, until=20.0, target=0,
+                    envelopes_per_tick=50, txs_per_tick=10, tick=0.4,
+                )
+            ]
+        elif cls in ("clock_skew_within_slip", "clock_skew_beyond_slip"):
+            # node 2 is a core node in the 4+4 shape; the core's 3-of-4
+            # majority absorbs a beyond-slip stall exactly like the
+            # small shape's 2-of-3
+            pass
+        elif cls == "asymmetric_partition":
+            pass  # deaf=[2] — core node, 3-of-4 majority holds
+        elif cls == "targeted_flood_tier2":
+            # re-aim at the 4-node tier ring of the 4+4 shape
+            big.faults = [
+                ByzantineFlood(
+                    at=0.5, until=20.0, targets=[4, 5, 6, 7],
+                    envelopes_per_tick=15, txs_per_tick=3, tick=0.4,
+                ),
+                OverloadStorm(
+                    at=0.5, until=20.0, source=4,
+                    msgs_per_tick=40, tick=0.25,
+                    drain_bytes_per_sec=16384,
+                    drain_nodes=[4, 5, 6, 7],
+                ),
+            ]
+            big.tiers = {"tier1": [0, 1, 2, 3], "tier2": [4, 5, 6, 7]}
+            big.liveness_exclude = [4, 5, 6, 7]
+            big.load_txs = 300
         out[cls] = big
+    # the big-only scale shape (ISSUE r19 / ROADMAP 6(b')): 4-core +
+    # 96-tier ring over REAL localhost TCP sockets — the per-peer
+    # bounded send queues and pack-once fan-out at production-transport
+    # scale.  Real clock (socket delivery is kernel-timed; the digest
+    # policy already excludes counters for real-clock runs), no
+    # link-level faults (loopback-only knobs), floors: ≥5 ledgers
+    # externalized by every one of the 100 nodes inside the timeout.
+    out["tcp_scale"] = ScenarioSpec(
+        name="tcp_scale_100",
+        fault_class="tcp_scale",
+        topology="core_and_tier",
+        overlay_mode="tcp",
+        clock_mode="real",
+        n_nodes=4,
+        tier_n=96,
+        # watchers: a 4-core committee decides, 96 tier nodes track and
+        # relay — 100 independent nominators churn nomination for
+        # minutes/slot, which is a different (known) pathology than the
+        # transport-scale claim this shape certifies
+        tier_validators=False,
+        seed=seed,
+        faults=[],
+        load_accounts=4,
+        load_txs=80,
+        load_rate=10,
+        tiers={"tier1": [0, 1, 2, 3], "tier2": list(range(4, 100))},
+        target_ledgers=7,
+        stabilize_ledgers=2,
+        min_ledgers_per_sec=0.0,
+        timeout=900.0,
+    )
     return out
 
 
@@ -368,9 +611,21 @@ def run_matrix(
     workdir: Optional[str] = None,
 ) -> List[ScenarioResult]:
     specs = small_specs(seed) if matrix == "small" else big_specs(seed)
+    if only:
+        # an EXPLICIT request for a class this matrix doesn't carry must
+        # not read as a green (empty) run — raise for every caller
+        # (bench, tests), not just the CLI's own pre-check
+        missing = [c for c in only if c not in specs]
+        if missing:
+            raise ValueError(
+                "fault class(es) not in the %s matrix: %s"
+                % (matrix, ",".join(missing))
+            )
     results = []
     for cls in FAULT_CLASSES:
         if only and cls not in only:
             continue
+        if cls not in specs:
+            continue  # big-only shape (tcp_scale) absent from small
         results.append(Scenario(specs[cls], workdir=workdir).run())
     return results

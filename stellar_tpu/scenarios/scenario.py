@@ -25,10 +25,10 @@ from __future__ import annotations
 import shutil
 import tempfile
 from dataclasses import dataclass, field
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from ..simulation import LoadGenerator, Simulation, topologies
-from ..simulation.simulation import OVER_LOOPBACK
+from ..simulation.simulation import OVER_LOOPBACK, OVER_TCP
 from ..tx.testutils import get_test_config
 from ..util import REAL_TIME, VIRTUAL_TIME, VirtualClock, VirtualTimer, xlog
 from ..xdr.scp import SCPQuorumSet
@@ -56,12 +56,27 @@ class ScenarioSpec:
     threshold: Optional[int] = None  # None = BFT majority
     topology: str = "core"  # "core" | "core_and_tier"
     tier_n: int = 0
+    # False = tier nodes are WATCHERS (track + relay, never nominate):
+    # the committee-plus-relays shape the 100+ node scale scenario runs
+    tier_validators: bool = True
     clock_mode: str = "virtual"  # "virtual" | "real"
+    # transport: "loopback" (in-process pairs, full fault surface) or
+    # "tcp" (real localhost sockets — the 100+ node scale shape, ISSUE
+    # r19; link-level fault knobs are loopback-only, node-API faults
+    # like floods still apply)
+    overlay_mode: str = "loopback"
     seed: int = 1
     # SCP envelope signature scheme for every node (Config.SCP_SIG_SCHEME):
     # "ed25519" or "ed25519-halfagg" — the flood matrix runs the same
     # storm under both and compares scheme verify wall
     scp_sig_scheme: str = "ed25519"
+    # signature backend for every node (Config.SIGNATURE_BACKEND): None
+    # keeps the test default ("cpu"); "tpu" engages the device batch
+    # plane (the tpu-backend flood leg, ISSUE r19 — tier-1 runs it on
+    # the XLA-CPU oracle).  tpu_cpu_cutover=0 forces every flush onto
+    # the device path so a flood-scale batch can't ride the host ladder.
+    signature_backend: Optional[str] = None
+    tpu_cpu_cutover: Optional[int] = None
     # load (streams through node `load_target` for the whole run)
     load_accounts: int = 6
     load_txs: int = 400
@@ -81,6 +96,15 @@ class ScenarioSpec:
     expect_straggler_disconnect: bool = False
     min_flood_sheds: int = 0
     assert_high_water_bounded: bool = False
+    # time-slip verdicts (ISSUE r19): the run must meter at least /
+    # at most this many closeTime-gate rejections (past + future,
+    # summed across nodes) — the skew classes' observable
+    min_slip_rejects: int = 0
+    max_slip_rejects: Optional[int] = None
+    # per-tier scoreboard aggregates: {tier_name: [node indices]} —
+    # report-only grouping (targeted faults read "tier-1 undisturbed,
+    # tier-2 shed" off it)
+    tiers: Optional[Dict[str, List[int]]] = None
     # liveness target + floors
     target_ledgers: int = 12  # absolute min LCL across nodes at the end
     stabilize_ledgers: int = 2
@@ -161,6 +185,10 @@ class Scenario:
         cfg.MANUAL_CLOSE = False
         cfg.ARTIFICIALLY_ACCELERATE_TIME_FOR_TESTING = True
         cfg.SCP_SIG_SCHEME = self.spec.scp_sig_scheme
+        if self.spec.signature_backend is not None:
+            cfg.SIGNATURE_BACKEND = self.spec.signature_backend
+        if self.spec.tpu_cpu_cutover is not None:
+            cfg.TPU_CPU_CUTOVER = self.spec.tpu_cpu_cutover
         if self.spec.sendq_bytes is not None:
             cfg.OVERLAY_SENDQ_BYTES = self.spec.sendq_bytes
         if self.spec.sendq_flood_msgs is not None:
@@ -190,16 +218,21 @@ class Scenario:
             os.makedirs(f"{self.workdir}/archive", exist_ok=True)
         mode = VIRTUAL_TIME if spec.clock_mode == "virtual" else REAL_TIME
         clock = VirtualClock(mode)
+        overlay_mode = (
+            OVER_TCP if spec.overlay_mode == "tcp" else OVER_LOOPBACK
+        )
         if spec.topology == "core_and_tier":
             sim = topologies.core_and_tier(
                 core_n=spec.n_nodes,
                 tier_n=spec.tier_n,
                 clock=clock,
                 cfg_factory=self._cfg,
+                mode=overlay_mode,
+                tier_validators=spec.tier_validators,
             )
             self.node_keys = sim.topology_keys
         else:
-            sim = Simulation(OVER_LOOPBACK, clock)
+            sim = Simulation(overlay_mode, clock)
             from ..crypto.keys import SecretKey
 
             keys = [
@@ -287,11 +320,18 @@ class Scenario:
                 )
 
             after = snapshot(sim)
+            tier_map = None
+            if spec.tiers:
+                tier_map = {
+                    tier: {self._raw(i).hex()[:8] for i in idxs}
+                    for tier, idxs in spec.tiers.items()
+                }
             sb = LivenessScoreboard.from_snapshots(
                 sim,
                 before,
                 after,
                 exclude_nodes=self._excluded_prefixes(),
+                tiers=tier_map,
                 scenario=spec.name,
                 fault_class=spec.fault_class,
                 seed=spec.seed,
@@ -323,6 +363,25 @@ class Scenario:
                 failures.append(
                     "recovery floor miss: %s ms (max %.0f)"
                     % (sb.recovery_ms, spec.max_recovery_ms)
+                )
+            # time-slip verdicts (ISSUE r19): the skew classes assert the
+            # closeTime gates actually fired (beyond-slip) or stayed
+            # silent (within-slip) — the metered observable, not just
+            # liveness side effects
+            total_slip = sb.slip_rejects_past + sb.slip_rejects_future
+            if spec.min_slip_rejects and total_slip < spec.min_slip_rejects:
+                failures.append(
+                    "expected >= %d metered time-slip rejections, got %d"
+                    % (spec.min_slip_rejects, total_slip)
+                )
+            if (
+                spec.max_slip_rejects is not None
+                and total_slip > spec.max_slip_rejects
+            ):
+                failures.append(
+                    "%d time-slip rejections metered against a ceiling"
+                    " of %d — a within-slip skew must not trip the gate"
+                    % (total_slip, spec.max_slip_rejects)
                 )
             # overlay survival plane verdicts — CRITICAL is never shed,
             # in ANY scenario (the tentpole contract)

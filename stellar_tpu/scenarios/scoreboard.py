@@ -91,6 +91,11 @@ def _node_counters(app) -> Dict[str, int]:
             else 0
         ),
         "externalized": h.m_value_externalize.count if h else 0,
+        # time-slip rejections (ISSUE r19): the herder's closeTime gates
+        # — under clock skew these are the defense that fires; crank-
+        # deterministic, so they join the virtual-mode digest
+        "slip_rejects_past": h.m_value_close_past.count if h else 0,
+        "slip_rejects_future": h.m_value_close_future.count if h else 0,
         "nomination_rounds": h.n_nomination_rounds if h else 0,
         "ballot_rounds": h.n_ballot_rounds if h else 0,
         "envelopes_emitted": h.m_envelope_emit.count if h else 0,
@@ -142,6 +147,12 @@ class LivenessScoreboard:
     flood_fanout: int = 0
     fast_rejects: int = 0  # invalid-sig envelopes rejected (eager + batch)
     fast_reject_rate_per_sec: float = 0.0
+    # time-and-asymmetry plane (ISSUE r19): closeTime-gate rejections —
+    # a skewed node rejecting the quorum's values reads as `future` on
+    # the skewed node; a forward-skewed proposer's values read as
+    # `future` on everyone else.  Crank-deterministic, digested.
+    slip_rejects_past: int = 0
+    slip_rejects_future: int = 0
     # recovery
     recovery_ms: Optional[float] = None  # heal/restart -> next agreed close
     # correctness
@@ -168,32 +179,39 @@ class LivenessScoreboard:
     # SCP signature-scheme plane (reported, excluded from digest: wall
     # timing; the flood A/B reads verify_wall_ms across schemes)
     aggregate: Dict[str, float] = field(default_factory=dict)
+    # per-tier aggregates (ISSUE r19; reported, not digested — the lean-
+    # digest policy): for specs that name tiers (core_and_tier shapes),
+    # ledger progress and survival-plane counters grouped per tier, so a
+    # targeted fault's verdict can read "tier-1 undisturbed, tier-2 shed"
+    per_tier: Dict[str, dict] = field(default_factory=dict)
     notes: List[str] = field(default_factory=list)
 
     @classmethod
     def from_snapshots(
-        cls, sim, before: Snapshot, after: Snapshot, exclude_nodes=(), **kw
+        cls, sim, before: Snapshot, after: Snapshot, exclude_nodes=(),
+        tiers=None, **kw
     ):
         """``exclude_nodes``: node hex prefixes excluded from the min-LCL
         liveness computation (a scenario's deliberate straggler must not
         gate the consensus floor it is designed to miss); every other
-        counter — and chain agreement — still covers them."""
+        counter — and chain agreement — still covers them.  ``tiers``:
+        optional {tier_name: set of node hex prefixes} — fills the
+        report-only per_tier aggregates (ISSUE r19)."""
         sb = cls(**kw)
         sb.wall_seconds = max(1e-9, after.at - before.at)
-        deltas = []
+        node_deltas = {}
         for node, c1 in after.counters.items():
             c0 = before.counters.get(node, {})
             # a restarted validator is a fresh Application: its counters
             # reset to zero mid-window, so a value below the snapshot
             # means "count since restart" — use it whole, not the
             # (negative) difference
-            deltas.append(
-                {
-                    k: (c1[k] - c0.get(k, 0)) if c1[k] >= c0.get(k, 0)
-                    else c1[k]
-                    for k in c1
-                }
-            )
+            node_deltas[node] = {
+                k: (c1[k] - c0.get(k, 0)) if c1[k] >= c0.get(k, 0)
+                else c1[k]
+                for k in c1
+            }
+        deltas = list(node_deltas.values())
         closed = [
             after.lcls[n] - before.lcls.get(n, 0)
             for n in after.lcls
@@ -209,6 +227,8 @@ class LivenessScoreboard:
             sb.flood_fanout += d["flood_fanout"]
             sb.fast_rejects += d["envelopes_invalid_sig"]
             sb.invariant_violations += d["invariant_violations"]
+            sb.slip_rejects_past += d.get("slip_rejects_past", 0)
+            sb.slip_rejects_future += d.get("slip_rejects_future", 0)
         sb.fast_reject_rate_per_sec = round(
             sb.fast_rejects / sb.wall_seconds, 2
         )
@@ -267,6 +287,37 @@ class LivenessScoreboard:
         sb.recv_load_sheds = sum(
             d.get("recv_load_sheds", 0) for d in deltas
         )
+        if tiers:
+            for tier, members in tiers.items():
+                tier_closed = [
+                    after.lcls[n] - before.lcls.get(n, 0)
+                    for n in after.lcls
+                    if n in members
+                ]
+                td = [d for n, d in node_deltas.items() if n in members]
+                tier_min = min(tier_closed) if tier_closed else 0
+                sb.per_tier[tier] = {
+                    "nodes": len(td),
+                    "ledgers_closed": tier_min,
+                    "ledgers_per_sec": round(tier_min / sb.wall_seconds, 3),
+                    "flood_sheds": sum(
+                        d.get("sendq.shed_flood", 0) for d in td
+                    ),
+                    "critical_sheds": sum(
+                        d.get("sendq.shed_critical", 0) for d in td
+                    ),
+                    "stragglers": sum(
+                        d.get("sendq.stragglers", 0) for d in td
+                    ),
+                    "fast_rejects": sum(
+                        d.get("envelopes_invalid_sig", 0) for d in td
+                    ),
+                    "slip_rejects": sum(
+                        d.get("slip_rejects_past", 0)
+                        + d.get("slip_rejects_future", 0)
+                        for d in td
+                    ),
+                }
         return sb
 
     def to_dict(self) -> dict:
@@ -305,6 +356,11 @@ class LivenessScoreboard:
                 # lean keeps cross-version replays comparable)
                 sendq_sheds=dict(sorted(self.sendq_sheds.items())),
                 sendq_stragglers=self.sendq_straggler_disconnects,
+                # closeTime-gate rejections are message/crank-order
+                # deterministic like fast_rejects (the skew schedules
+                # are pure functions of the shared virtual clock)
+                slip_rejects_past=self.slip_rejects_past,
+                slip_rejects_future=self.slip_rejects_future,
             )
         return sha256(
             json.dumps(stable, sort_keys=True).encode()

@@ -53,8 +53,18 @@ class Simulation:
         # active partition: list of frozensets of node keys; links crossing
         # group boundaries stay severed until heal()
         self._partition_groups: List[frozenset] = []
-        # per-link fault profile + deterministic reseed bookkeeping
-        self._link_profiles: Dict[frozenset, FaultProfile] = {}
+        # active ONE-WAY partition: (src_set, dst_set) — frames src→dst
+        # keep flowing, frames dst→src are silently dropped at the send
+        # choke point (the half-open-connection case the symmetric groups
+        # API cannot express; links stay up and authenticated)
+        self._oneway: Optional[Tuple[frozenset, frozenset]] = None
+        # per-link fault profile + deterministic reseed bookkeeping;
+        # value = (profile, src) where src names the single sending node
+        # the profile applies to (directional faults) or None for both
+        self._link_profiles: Dict[frozenset, Tuple[FaultProfile, Optional[bytes]]] = {}
+        # per-node clock-offset schedules (bytes key -> float | callable),
+        # re-applied across restart_node so skew is a NODE property
+        self._clock_offsets: Dict[bytes, object] = {}
         self._fault_seed = 0
         self._link_flaps: Dict[frozenset, int] = {}
         self._crashed: Dict[bytes, Tuple[SecretKey, object]] = {}
@@ -68,23 +78,34 @@ class Simulation:
         cfg=None,
         new_db: bool = True,
         force_scp: bool = True,
+        validator: bool = True,
     ) -> Application:
         """force_scp=False models the reference's restart-without-FORCE_SCP
         (HerderTests.cpp "No Force SCP"): the node restores its last SCP
         statements from the DB and rebroadcasts, but does not start new
-        rounds until it hears consensus."""
+        rounds until it hears consensus.  validator=False builds a WATCHER:
+        it evaluates its quorum set to follow consensus (and relays SCP
+        traffic) but never nominates or votes — the committee-plus-relays
+        shape the 100+ node scale scenario runs."""
         if cfg is None:
             cfg = get_test_config(self._next_instance)
         self._next_instance += 1
         cfg.NODE_SEED = secret
-        cfg.NODE_IS_VALIDATOR = True
+        cfg.NODE_IS_VALIDATOR = validator
         cfg.QUORUM_SET = qset
-        cfg.FORCE_SCP = force_scp
+        # a watcher cannot bootstrap consensus (Herder.bootstrap asserts
+        # a validator); it joins by hearing the committee externalize
+        cfg.FORCE_SCP = force_scp and validator
         cfg.MANUAL_CLOSE = False
         cfg.RUN_STANDALONE = self.mode == OVER_LOOPBACK
         cfg.HTTP_PORT = 0
         app = Application.create(self.clock, cfg, new_db=new_db)
         self.nodes[secret.public_raw] = app
+        # skew is a NODE property: a restarted validator keeps its bad
+        # clock (the ops reality — rebooting does not fix a wrong RTC)
+        off = self._clock_offsets.get(secret.public_raw)
+        if off is not None:
+            app.clock_offset_fn = self._as_offset_fn(off)
         return app
 
     def get_node(self, key) -> Application:
@@ -113,9 +134,10 @@ class Simulation:
         if self.mode == OVER_LOOPBACK:
             conn = LoopbackPeerConnection(self.nodes[ia], self.nodes[ib])
             self._live.append((conn, (ia, ib)))
-            profile = self._link_profiles.get(frozenset((ia, ib)))
-            if profile is not None:
-                self._arm_profile(conn, ia, ib, profile)
+            entry = self._link_profiles.get(frozenset((ia, ib)))
+            if entry is not None:
+                self._arm_profile(conn, ia, ib, entry)
+            self._apply_oneway_to(conn, ia, ib)
         else:
             target = self.nodes[ib]
             self.nodes[ia].overlay_manager.connect_to(
@@ -143,14 +165,18 @@ class Simulation:
 
     def _arm_profile(
         self, conn: LoopbackPeerConnection, ia: bytes, ib: bytes,
-        profile: FaultProfile,
+        entry: Tuple[FaultProfile, Optional[bytes]],
     ) -> None:
-        """Apply a fault profile to both sides of a live loopback pair,
-        reseeding each side from (root seed, link identity, side, flap
-        count) so re-runs roll identical faults and reconnects after a
-        flap roll fresh-but-deterministic sequences."""
+        """Apply a fault profile to a live loopback pair, reseeding each
+        side from (root seed, link identity, side, flap count) so re-runs
+        roll identical faults and reconnects after a flap roll fresh-but-
+        deterministic sequences.  ``entry`` = (profile, src): src None
+        applies the profile to BOTH senders; otherwise only the peer
+        owned by ``src`` (the one-way profile — frames src→peer ride the
+        faults, the reverse sender stays clean)."""
         from ..crypto import sha256
 
+        profile, src = entry
         link = frozenset((ia, ib))
         flap = self._link_flaps.get(link, 0)
         # stable digest, NOT hash(): bytes hashing is salted per process
@@ -164,21 +190,35 @@ class Simulation:
             )[:8],
             "big",
         )
-        profile.apply(conn.initiator, seed=base ^ 0x5EED0001)
-        profile.apply(conn.acceptor, seed=base ^ 0x5EED0002)
+        clean = FaultProfile()
+        # conn.initiator is owned by (and sends FROM) node ia; acceptor
+        # sends from ib — the directional profile arms exactly one side
+        init_prof = profile if src is None or src == ia else clean
+        acc_prof = profile if src is None or src == ib else clean
+        init_prof.apply(conn.initiator, seed=base ^ 0x5EED0001)
+        acc_prof.apply(conn.acceptor, seed=base ^ 0x5EED0002)
 
-    def set_link_faults(self, profile: FaultProfile, a=None, b=None) -> None:
+    def set_link_faults(
+        self, profile: FaultProfile, a=None, b=None, direction: str = "both"
+    ) -> None:
         """Install `profile` on the link (a, b), or on EVERY link when both
         are None; live connections are armed now, reconnections (doctor,
-        heal) re-arm automatically."""
+        heal) re-arm automatically.  ``direction`` picks the sender the
+        profile applies to: "both" (default), or "a-to-b"/"b-to-a" for the
+        ONE-WAY profile — only frames flowing that way ride the faults,
+        the reverse sender stays clean (requires explicit a and b)."""
         assert self.mode == OVER_LOOPBACK, "fault knobs ride loopback pairs"
-        targets = (
-            [frozenset(l) for l in self.links]
-            if a is None and b is None
-            else [frozenset((self._raw_key(a), self._raw_key(b)))]
-        )
+        assert direction in ("both", "a-to-b", "b-to-a")
+        if a is None and b is None:
+            assert direction == "both", "one-way profiles need an explicit link"
+            targets = [frozenset(l) for l in self.links]
+            src = None
+        else:
+            ra, rb = self._raw_key(a), self._raw_key(b)
+            targets = [frozenset((ra, rb))]
+            src = {"both": None, "a-to-b": ra, "b-to-a": rb}[direction]
         for link in targets:
-            self._link_profiles[link] = profile
+            self._link_profiles[link] = (profile, src)
         for conn, (ia, ib) in self._live:
             if frozenset((ia, ib)) in self._link_profiles and not (
                 conn.initiator._closed and conn.acceptor._closed
@@ -208,10 +248,32 @@ class Simulation:
                 return True
         return False
 
-    def partition(self, *groups) -> None:
+    def partition(self, *groups, oneway: bool = False) -> None:
         """Sever every link crossing the given node groups (each group a
         list of keys); the split stays enforced (the doctor will not
-        re-establish crossing links) until ``heal``."""
+        re-establish crossing links) until ``heal``.
+
+        ``oneway=True`` (exactly two groups) is the ASYMMETRIC split the
+        symmetric API cannot express: frames group0→group1 keep flowing,
+        frames group1→group0 are silently dropped at the send choke
+        point — BEFORE a MAC sequence number is consumed, so the links
+        stay up and authenticated (the real half-open-connection shape:
+        one direction dead, the reverse still delivering with valid
+        MACs), and ``heal`` resumes the dropped direction on the SAME
+        connection with the sequence intact — no flap."""
+        if oneway:
+            assert self.mode == OVER_LOOPBACK, (
+                "one-way splits arm blackholes on loopback pairs — an"
+                " OVER_TCP sim would silently keep delivering"
+            )
+            assert len(groups) == 2, "one-way split takes exactly two groups"
+            self._oneway = (
+                frozenset(self._raw_key(k) for k in groups[0]),
+                frozenset(self._raw_key(k) for k in groups[1]),
+            )
+            for conn, (ia, ib) in self._live:
+                self._apply_oneway_to(conn, ia, ib)
+            return
         self._partition_groups = [
             frozenset(self._raw_key(k) for k in g) for g in groups
         ]
@@ -219,10 +281,63 @@ class Simulation:
             if self._crosses_partition(ia, ib):
                 self._sever_connection(conn)
 
+    def _apply_oneway_to(
+        self, conn: LoopbackPeerConnection, ia: bytes, ib: bytes
+    ) -> None:
+        """Arm/clear the outbound blackholes a one-way partition implies
+        on one live pair (idempotent; also clears when no split is up).
+        The dropped direction is group1→group0: blackhole the peer whose
+        OWNER is in group1 and whose remote is in group0."""
+        if self._oneway is None:
+            conn.initiator.outbound_blackhole = False
+            conn.acceptor.outbound_blackhole = False
+            return
+        src_ok, dst = self._oneway
+        # initiator sends ia→ib, acceptor sends ib→ia
+        conn.initiator.outbound_blackhole = ia in dst and ib in src_ok
+        conn.acceptor.outbound_blackhole = ib in dst and ia in src_ok
+
     def heal(self) -> None:
-        """Lift the partition and re-establish the severed links now."""
+        """Lift the partition (symmetric AND one-way) and re-establish /
+        resume the severed or silenced links now."""
         self._partition_groups = []
+        if self._oneway is not None:
+            self._oneway = None
+            for conn, (ia, ib) in self._live:
+                self._apply_oneway_to(conn, ia, ib)
         self.ensure_links()
+
+    # -- per-node clocks ----------------------------------------------------
+    @staticmethod
+    def _as_offset_fn(offset):
+        """Normalize a skew spec (constant seconds or callable(now) ->
+        seconds) to the Application.clock_offset_fn shape."""
+        if callable(offset):
+            return offset
+        const = float(offset)
+        return lambda _now: const
+
+    def set_clock_offset(self, key, offset) -> None:
+        """Per-node clock-skew seam (ISSUE r19): shift ``key``'s WALL-time
+        view (Application.time_now — closeTime nomination and the
+        MAX_TIME_SLIP_SECONDS gate) by ``offset`` seconds — a constant, or
+        a callable(shared_clock_now) -> seconds for drift/step schedules
+        (scenarios/faults.py ClockSkew).  Deterministic: schedules are
+        pure functions of the shared virtual clock.  Survives
+        restart_node — a rebooted validator keeps its bad clock."""
+        raw = self._raw_key(key)
+        self._clock_offsets[raw] = offset
+        app = self.nodes.get(raw)
+        if app is not None:
+            app.clock_offset_fn = self._as_offset_fn(offset)
+
+    def clear_clock_offset(self, key) -> None:
+        """Heal ``key``'s clock back to the shared truth (NTP fixed it)."""
+        raw = self._raw_key(key)
+        self._clock_offsets.pop(raw, None)
+        app = self.nodes.get(raw)
+        if app is not None:
+            app.clock_offset_fn = None
 
     def ensure_links(self) -> None:
         """The link doctor: re-establish every expected-topology link whose
@@ -408,6 +523,11 @@ class Simulation:
                     "peers": (
                         app.overlay_manager.get_authenticated_peer_count()
                         if app.overlay_manager
+                        else 0
+                    ),
+                    "clock_offset": (
+                        round(app.clock_offset_fn(self.clock.now()), 3)
+                        if app.clock_offset_fn is not None
                         else 0
                     ),
                 }

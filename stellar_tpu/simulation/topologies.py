@@ -100,19 +100,40 @@ def core_and_tier(
     tier_n: int = 4,
     clock: Optional[VirtualClock] = None,
     cfg_factory=None,
+    mode: str = OVER_LOOPBACK,
+    tier_validators: bool = True,
 ) -> Simulation:
     """Core-and-tier quorum ring (SURVEY §2.11; the chaos plane's default
     big shape): a fully-meshed core of ``core_n`` validators sharing one
     BFT-majority quorum set, plus a RING of ``tier_n`` tier-2 validators —
-    each tier node's quorum slice is {threshold 2: [self, ring-successor],
-    inner: core} and its links are its two ring neighbors plus one core
-    node.  Consensus must traverse the ring through the core, so
-    partitions that cut ring chords exercise multi-hop flood relay.
+    each tier node's quorum slice is {threshold 2: [self, inner: core]}
+    (itself plus a core quorum, the hierarchicalQuorumSimplified outer
+    shape) and its links are its two ring neighbors plus one core node.
+    Consensus must traverse the ring through the core, so partitions that
+    cut ring chords exercise multi-hop flood relay.
+
+    ``tier_validators=False`` makes every tier node a WATCHER (tracks and
+    relays, never nominates) — the committee-plus-relays shape: at 100+
+    nodes a hundred independent nominators churn nomination for minutes
+    per slot, while a 4-core committee with 96 relaying watchers closes
+    at cadence and still drives the full fan-out/sendqueue surface (the
+    committee-based-consensus framing of arXiv:2302.00418).
+
+    The ring is deliberately RELAY-ONLY, not a trust edge: the pre-r19
+    slice {threshold 2: [self, ring-successor], inner: core} made any
+    ring cycle SELF-QUORATE — the targeted_flood_tier2 chaos class
+    proved a flood-isolated tier pair would externalize its own values
+    and fork from the core (safety, not just liveness).  With the core
+    required in every tier slice, an isolated tier can only stall and
+    recover, never fork.
 
     ``cfg_factory(i)`` (optional) supplies each node's Config — the
     scenario runner uses it to pin disk DBs / archives; ``i`` counts core
-    nodes first, then tier nodes."""
-    sim = Simulation(OVER_LOOPBACK, clock)
+    nodes first, then tier nodes.  ``mode=OVER_TCP`` wires the same shape
+    over real localhost sockets (the 100+ node scale scenario, ISSUE r19
+    — the fault knobs stay loopback-only, but load/flood node APIs and
+    the sendqueue/fan-out planes run against the production transport)."""
+    sim = Simulation(mode, clock)
     ck = _keys(core_n)
     core_threshold = core_n - (core_n - 1) // 3
     core_qset = SCPQuorumSet(
@@ -130,10 +151,9 @@ def core_and_tier(
         SecretKey.pseudo_random_for_testing(300 + i) for i in range(tier_n)
     ]
     for i, x in enumerate(tk):
-        succ = tk[(i + 1) % tier_n]
         qset = SCPQuorumSet(
             2,
-            [x.get_public_key(), succ.get_public_key()],
+            [x.get_public_key()],
             [core_qset],
         )
         sim.add_node(
@@ -141,6 +161,7 @@ def core_and_tier(
             cfg=(
                 cfg_factory(core_n + i) if cfg_factory is not None else None
             ),
+            validator=tier_validators,
         )
     for i in range(tier_n):
         sim.add_pending_connection(tk[i], tk[(i + 1) % tier_n])

@@ -301,6 +301,176 @@ def test_fetch_retry_backoff_and_metered_give_up(two_apps):
     t3.finish("test")
 
 
+# -- one-way (half-open) link mechanics (ISSUE r19) -------------------------
+
+
+def test_loopback_oneway_blackhole_keeps_reverse_mac_sequence(two_apps):
+    """Directional drop mechanics at the LoopbackPeer level: blackholing
+    one side's outbound silences exactly that direction — the reverse
+    direction keeps delivering with valid MACs (no flap), the silenced
+    side consumes NO MAC sequence numbers (the drop is pre-queue,
+    pre-seq), and clearing the flag resumes the SAME connection with the
+    sequence intact."""
+    clock, a, b = two_apps
+    conn = LoopbackPeerConnection(a, b)
+    crank(clock)
+    assert conn.initiator.is_authenticated()
+    init, acc = conn.initiator, conn.acceptor
+
+    seq_before = init.send_mac_seq
+    recv_before_acc = acc._m_recv.count
+    recv_before_init = init._m_recv.count
+
+    # silence initiator→acceptor
+    init.outbound_blackhole = True
+    for _ in range(3):
+        init.send_get_peers()
+    crank(clock)
+    assert acc._m_recv.count == recv_before_acc  # nothing arrived
+    assert init.send_mac_seq == seq_before  # nothing sequenced
+
+    # the reverse direction still works mid-blackhole (and its replies
+    # from the silenced side vanish without breaking anything)
+    acc.send_get_peers()
+    crank(clock)
+    assert init._m_recv.count > recv_before_init
+    assert init.is_authenticated() and acc.is_authenticated()
+
+    # heal: the SAME connection resumes, MAC sequence intact — no flap
+    init.outbound_blackhole = False
+    recv_mid_acc = acc._m_recv.count
+    init.send_get_peers()
+    crank(clock)
+    assert acc._m_recv.count > recv_mid_acc
+    assert init.is_authenticated() and acc.is_authenticated()
+    assert init.state != PeerState.CLOSING and acc.state != PeerState.CLOSING
+
+
+def test_simulation_oneway_partition_and_heal():
+    """Simulation.partition(oneway=True) semantics end-to-end: node 2 is
+    heard by the others but hears nothing (rest→2 dropped), the links
+    never flap (stay authenticated throughout), and heal() resumes both
+    directions on the same connections."""
+    from stellar_tpu.crypto.keys import SecretKey
+    from stellar_tpu.simulation import OVER_LOOPBACK, Simulation
+    from stellar_tpu.xdr.scp import SCPQuorumSet
+
+    clock = VirtualClock()
+    sim = Simulation(OVER_LOOPBACK, clock)
+    keys = [SecretKey.pseudo_random_for_testing(i + 1) for i in range(3)]
+    qset = SCPQuorumSet(2, [k.get_public_key() for k in keys], [])
+    for i, k in enumerate(keys):
+        cfg = T.get_test_config(i)
+        cfg.MANUAL_CLOSE = False
+        cfg.ARTIFICIALLY_ACCELERATE_TIME_FOR_TESTING = True
+        sim.add_node(k, qset, cfg=cfg)
+    for i in range(3):
+        for j in range(i + 1, 3):
+            sim.add_pending_connection(keys[i], keys[j])
+    sim.start_all_nodes()
+    try:
+        assert sim.crank_until(lambda: sim.have_all_externalized(2), 60)
+
+        sim.partition([keys[2]], keys[:2], oneway=True)
+        deaf = sim.get_node(keys[2])
+        lcl_deaf = deaf.ledger_manager.get_last_closed_ledger_num()
+        # majority closes on; the deaf node stalls but its links stay up
+        sim.crank_until(
+            lambda: sim.get_node(keys[0])
+            .ledger_manager.get_last_closed_ledger_num()
+            >= lcl_deaf + 2,
+            60,
+        )
+        assert (
+            deaf.ledger_manager.get_last_closed_ledger_num() == lcl_deaf
+        )
+        assert deaf.overlay_manager.get_authenticated_peer_count() == 2
+        assert sim.link_is_up(keys[2], keys[0])
+
+        sim.heal()
+        # the stall probe replays the missed slots on the SAME links
+        assert sim.crank_until(
+            lambda: sim.have_all_externalized(lcl_deaf + 3), 60
+        ), sim.ledger_nums()
+        assert sim.all_ledgers_agree()
+    finally:
+        sim.stop_all_nodes()
+        sim.clock.shutdown()
+        # this sim uses the canonical test keys/genesis — leave the
+        # process-global verify cache clean for cache-sensitive tests
+        # (test_simulation's tpu-backend round asserts device_calls > 0)
+        from stellar_tpu.crypto.keys import verify_cache
+
+        verify_cache().clear()
+
+
+def test_tcp_oneway_blackhole_over_real_sockets():
+    """The same one-way mechanics on the PRODUCTION transport: the
+    blackhole seam lives at Peer.send_message (pre-queue, pre-seq), so a
+    TCPPeer pair behaves identically — one direction silenced, reverse
+    flowing, heal resumes the same socket without an auth/MAC flap.
+
+    REAL_TIME clock, like the tcp_scale scenario shape: kernel socket
+    delivery cannot be virtual-time-cranked — an idle virtual crank leaps
+    to the next timer deadline faster than localhost delivers, so the
+    frame "in flight" misses its poll window and idle timers fire
+    spuriously."""
+    from stellar_tpu.overlay import PeerRecord
+    from stellar_tpu.util import REAL_TIME
+
+    clock = VirtualClock(REAL_TIME)
+    cfg_a = T.get_test_config(14)
+    cfg_b = T.get_test_config(15)
+    for cfg in (cfg_a, cfg_b):
+        cfg.RUN_STANDALONE = False
+        cfg.HTTP_PORT = 0
+    a = Application.create(clock, cfg_a, new_db=True)
+    b = Application.create(clock, cfg_b, new_db=True)
+    a.start()
+    b.start()
+    try:
+        a.overlay_manager.connect_to(
+            PeerRecord("127.0.0.1", cfg_b.PEER_PORT)
+        )
+        assert clock.crank_until(
+            lambda: a.overlay_manager.get_authenticated_peer_count() == 1
+            and b.overlay_manager.get_authenticated_peer_count() == 1,
+            timeout=10,
+        )
+        pa = a.overlay_manager.authenticated_peers()[0]
+        pb = b.overlay_manager.authenticated_peers()[0]
+        # let the post-handshake exchange (GET_PEERS, SCP state) drain
+        # so the silence baselines below are clean
+        clock.crank_until(lambda: False, 0.5)
+
+        pa.outbound_blackhole = True
+        seq_before = pa.send_mac_seq
+        recv_b = pb._m_recv.count
+        for _ in range(3):
+            pa.send_get_peers()
+        clock.crank_until(lambda: False, 0.3)
+        assert pb._m_recv.count == recv_b
+        assert pa.send_mac_seq == seq_before
+
+        recv_a = pa._m_recv.count
+        pb.send_get_peers()
+        assert clock.crank_until(
+            lambda: pa._m_recv.count > recv_a, 5
+        )
+
+        pa.outbound_blackhole = False
+        recv_b2 = pb._m_recv.count
+        pa.send_get_peers()
+        assert clock.crank_until(
+            lambda: pb._m_recv.count > recv_b2, 5
+        )
+        assert pa.is_authenticated() and pb.is_authenticated()
+    finally:
+        a.graceful_stop()
+        b.graceful_stop()
+        clock.shutdown()
+
+
 # -- TCP transport ---------------------------------------------------------
 
 
