@@ -1290,6 +1290,47 @@ def _measure_selfcheck_ms(app) -> float:
         return -1.0  # never let the diagnostic leg kill the close line
 
 
+def _measure_ingest_admission(app, n_txs=256):
+    """Standing flood-defense leg (ISSUE r20): ``n_txs`` invalid-signature
+    payments from the root account through the verify-at-ingest front
+    door.  The source account EXISTS, so the candidate triples hint-match
+    and the edge shed — not check_valid — pays the batched verify and the
+    reject; occupancy is the mean fill of the size-trigger batches the
+    flood packs.  Returns (rejects_per_sec, batch_occupancy); zeros when
+    the admission plane is disabled."""
+    from stellar_tpu.tx import testutils as T
+
+    plane = getattr(app, "ingest", None)
+    if plane is None or not plane.enabled:
+        return 0.0, 0.0
+    try:
+        root = T.root_key_for(app)
+        dst = T.get_account("bench-ingest")
+        txs = []
+        for i in range(n_txs):
+            frame = T.tx_from_ops(
+                app,
+                root,
+                (1 << 50) + i,
+                [T.create_account_op(dst, 10**9)],
+            )
+            sig = bytearray(frame.envelope.signatures[0].signature)
+            sig[0] ^= 0xFF
+            frame.envelope.signatures[0].signature = bytes(sig)
+            txs.append(frame)
+        before = plane.m_reject_badsig.count
+        t0 = time.perf_counter()
+        for frame in txs:
+            plane.submit(frame)
+        plane.flush_now()
+        elapsed = max(time.perf_counter() - t0, 1e-9)
+        shed = plane.m_reject_badsig.count - before
+        occ = plane.stats()["occupancy_mean"]
+        return round(shed / elapsed, 1), round(occ, 3)
+    except Exception:
+        return -1.0, -1.0  # diagnostic leg must never kill the close line
+
+
 def bench_ledger_close(n_txs=5000, n_ledgers=3):
     """p50/p95 wall time to validate + close a ledger carrying an
     ``n_txs``-transaction TxSet of single-sig payments (BASELINE.md's
@@ -1518,6 +1559,11 @@ def bench_ledger_close(n_txs=5000, n_ledgers=3):
         )
         inv_all_on_ms = inv.close_costs[-1] if inv.close_costs else 0.0
 
+        # verify-at-ingest admission plane (ISSUE r20): a standing
+        # flood-defense leg on every close line — untimed relative to the
+        # closes above, but measured in the same process/window
+        ingest_rps, ingest_occ = _measure_ingest_admission(app)
+
         times.sort()
         p50 = statistics.median(times)
         p95 = times[min(len(times) - 1, int(0.95 * len(times)))]
@@ -1580,6 +1626,11 @@ def bench_ledger_close(n_txs=5000, n_ledgers=3):
             # loads (bucket re-hash dominates) — a boot-cost regression
             # shows up here without waiting for a real restart
             "selfcheck_ms": _measure_selfcheck_ms(app),
+            # verify-at-ingest admission plane (ISSUE r20): edge-shed
+            # throughput on a hint-matching invalid-signature flood, and
+            # the mean fill of the size-trigger batches the flood packed
+            "ingest_rejects_per_sec": ingest_rps,
+            "ingest_batch_occupancy": ingest_occ,
         }
     finally:
         app.graceful_stop()

@@ -202,6 +202,15 @@ FINAL_STEPS = [
       "tcp_scale",
       "--json"],
      1800),
+    # verify-at-ingest admission plane (ISSUE r20): 10x invalid-signature
+    # tx flood from an EXISTING account — the edge shed must absorb it
+    # with the verify cache unpolluted and liveness above the floor
+    ("ingest_admission_r20",
+     [sys.executable, "-u", "-m", "stellar_tpu.scenarios",
+      "--matrix", "big",
+      "--only", "ingest_flood",
+      "--json"],
+     1800),
 ]
 ALL_NAMES = (
     [s[0] for s in SCRIPT_STEPS]

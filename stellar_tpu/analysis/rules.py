@@ -194,12 +194,20 @@ class CacheLatchRule(Rule):
     # caller's thread, and ONLY True verdicts can reach that latch
     # (completeness of the half-aggregation check is exact) — the same
     # valid-only contract as the synchronous CachingSigBackend path, with
-    # no async future to quarantine.  Fixtures: cache_latch_{pos,neg}.py.
+    # no async future to quarantine.
+    # IngestPlane (ingest/plane.py, r20): the admission flush owns its
+    # own peek/verify/latch split (unwrapping CachingSigBackend would
+    # re-hash and re-peek every key on the miss path) and latches
+    # synchronously on the caller's crank with the identical valid-only
+    # filter (`... if ok`) — a flooded batch of invalid-sig txs leaves
+    # no verdicts behind.  Fixtures: cache_latch_{pos,neg}.py; contract
+    # record in SWEEP.md r20.
     LATCH_CLASSES = {
         "VerifySigCache",
         "CachingSigBackend",
         "SigFlushFuture",
         "HalfAggScheme",
+        "IngestPlane",
     }
 
     def applies(self, ctx: FileContext) -> bool:
@@ -297,10 +305,12 @@ class DeterminismRule(Rule):
     # simulation/ + scenarios/ joined in r12: the chaos plane's replay
     # contract (same topology + seed + fault program ⇒ same run) holds
     # only if every roll in the harness itself is seeded and all time
-    # flows through the clock
+    # flows through the clock.  ingest/ joined in r20: the admission
+    # plane's deadline flushes and token buckets must ride the
+    # VirtualClock or the scenario digests stop replaying.
     SCOPED = (
         "scp/", "herder/", "ledger/", "overlay/", "history/",
-        "simulation/", "scenarios/",
+        "simulation/", "scenarios/", "ingest/",
     )
     DATETIME_CALLS = {"now", "utcnow", "today"}
 

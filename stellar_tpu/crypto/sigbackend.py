@@ -36,6 +36,7 @@ VerifyTriple = Tuple[bytes, bytes, bytes]  # (pubkey32, msg, sig64)
 CALLER_CLOSE = "close"        # synchronous close-path / check_valid flushes
 CALLER_PIPELINE = "pipeline"  # close-pipeline async prewarms (ledger N+1)
 CALLER_OVERLAY = "overlay"    # per-crank SCP envelope batch flushes
+CALLER_INGEST = "ingest"      # tx admission-plane micro-batches (front door)
 
 
 class SigFlushFuture:

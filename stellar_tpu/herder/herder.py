@@ -124,6 +124,15 @@ class Herder(SCPDriver):
         # 4 generations of received txs, shifted at each close
         # (HerderImpl.h:157, HerderImpl.cpp:611-628)
         self.received_transactions: List[Dict[bytes, TxMap]] = [{} for _ in range(4)]
+        # ingest-rate fast lane over the generations (ISSUE r20
+        # satellite): every pending tx hash (duplicate checks go through
+        # ONE set instead of a per-generation probe) and a per-account
+        # cache of (total fees, highest seq) summed ACROSS generations.
+        # Aging only moves txs between generations — the cross-generation
+        # aggregate is invariant under it — so the cache is dropped only
+        # where txs actually leave the queue (_remove_received_txs).
+        self._pending_tx_ids: set = set()
+        self._acct_agg: Dict[bytes, List[int]] = {}
 
         self.tracking: Optional[ConsensusData] = None
         self.current_value: bytes = b""
@@ -205,6 +214,10 @@ class Herder(SCPDriver):
         self.m_scp_state_probe = m.new_meter(
             ("herder", "scp-state", "probe"), "probe"
         )
+        # duplicate tx submissions (ISSUE r20 satellite): a silent return
+        # pre-r20 — under flood this is the cheapest reject in the node
+        # and the meter is the only observable of re-flooded traffic
+        self.m_tx_duplicate = m.new_meter(("herder", "tx", "duplicate"), "tx")
         # stall-probe bookkeeping (see _note_quorum_ahead): last local
         # consensus progress and last probe, on the app clock; the
         # quorum-member set is cached keyed by local qset hash
@@ -692,17 +705,26 @@ class Herder(SCPDriver):
         acc = tx.source_bytes()
         tx_id = tx.get_full_hash()
 
-        tot_fee = tx.get_fee()
-        high_seq = 0
-        for gen in self.received_transactions:
-            txmap = gen.get(acc)
-            if txmap is not None:
-                if tx_id in txmap.transactions:
-                    return TX_STATUS_DUPLICATE
-                tot_fee += txmap.total_fees
-                high_seq = max(high_seq, txmap.max_seq)
+        # O(1) duplicate check against ALL generations (a tx hash lives in
+        # at most one generation; aging moves it, removal discards it)
+        if tx_id in self._pending_tx_ids:
+            self.m_tx_duplicate.mark()
+            return TX_STATUS_DUPLICATE
 
-        if not tx.check_valid(self.app, high_seq):
+        agg = self._acct_agg.get(acc)
+        if agg is None:
+            fees = 0
+            high_seq = 0
+            for gen in self.received_transactions:
+                txmap = gen.get(acc)
+                if txmap is not None:
+                    fees += txmap.total_fees
+                    high_seq = max(high_seq, txmap.max_seq)
+            agg = [fees, high_seq]
+            self._acct_agg[acc] = agg
+        tot_fee = tx.get_fee() + agg[0]
+
+        if not tx.check_valid(self.app, agg[1]):
             return TX_STATUS_ERROR
 
         if tx.signing_account.get_balance_above_reserve(self.ledger_manager) < tot_fee:
@@ -710,15 +732,32 @@ class Herder(SCPDriver):
             return TX_STATUS_ERROR
 
         self.received_transactions[0].setdefault(acc, TxMap()).add_tx(tx)
+        self._pending_tx_ids.add(tx_id)
+        agg[0] += tx.get_fee()
+        if tx.get_seq_num() > agg[1]:
+            agg[1] = tx.get_seq_num()
         return TX_STATUS_PENDING
 
     def recv_tx_set_txs(self, txset) -> bool:
-        """Feed every tx of a downloaded set through recv_transaction."""
+        """Feed every tx of a downloaded set into the queue — through the
+        ingest plane's replay edge when it exists (ONE batched signature
+        dispatch per accumulator fill instead of per-tx eager verifies;
+        no rate/surge admission on replay), else per-tx."""
+        txs = txset.sort_for_apply()
+        ingest = getattr(self.app, "ingest", None)
+        if ingest is not None:
+            statuses = ingest.submit_replay(txs)
+            return all(s == TX_STATUS_PENDING for s in statuses)
         ok = True
-        for tx in txset.sort_for_apply():
+        for tx in txs:
             if self.recv_transaction(tx) != TX_STATUS_PENDING:
                 ok = False
         return ok
+
+    def num_pending_txs(self) -> int:
+        """Queue depth across all generations (the ingest plane's surge
+        high-water measure)."""
+        return len(self._pending_tx_ids)
 
     def get_max_seq_in_pending_txs(self, acc: PublicKey) -> int:
         high = 0
@@ -739,6 +778,7 @@ class Herder(SCPDriver):
                 if txmap is None:
                     continue
                 if txmap.transactions.pop(tx.get_full_hash(), None) is not None:
+                    self._pending_tx_ids.discard(tx.get_full_hash())
                     if not txmap.transactions:
                         del gen[acc]
                     else:
@@ -746,6 +786,10 @@ class Herder(SCPDriver):
             for acc in dirty:
                 if acc in gen:
                     gen[acc].recalculate()
+        # fee/seq aggregates for the touched accounts are stale now;
+        # recomputed lazily at the next submission from each account
+        for tx in drop_txs:
+            self._acct_agg.pop(tx.source_bytes(), None)
 
     # ------------------------------------------------------------------
     # SCP envelope queue

@@ -109,6 +109,7 @@ class Application:
         self.overlay_manager = None
         self.command_handler = None
         self.process_manager = None
+        self.ingest = None  # verify-at-ingest admission plane (create())
         # boot self-check report (main/selfcheck.py), served on /selfcheck
         self.last_selfcheck: Optional[dict] = None
         # per-node wall-clock skew seam (chaos plane, ISSUE r19): maps the
@@ -132,6 +133,7 @@ class Application:
     def create(cls, clock: VirtualClock, config: Config, new_db: bool = False):
         app = cls(clock, config, new_db=new_db)
         from ..herder.herder import Herder
+        from ..ingest import IngestPlane
         from ..overlay.manager import OverlayManager
         from ..process.manager import ProcessManager
         from .commandhandler import CommandHandler
@@ -139,6 +141,9 @@ class Application:
         app.process_manager = ProcessManager(app)
         app.overlay_manager = OverlayManager(app)
         app.herder = Herder(app)
+        # admission front door: every tx submission edge (/tx, overlay
+        # flood, loadgen, catchup replay) routes through here
+        app.ingest = IngestPlane(app)
         app.command_handler = CommandHandler(app)
         return app
 
@@ -213,6 +218,11 @@ class Application:
             self.command_handler.start()
 
     def graceful_stop(self) -> None:
+        if self.ingest is not None:
+            # drain the admission accumulator FIRST: every queued
+            # submitter gets an answer while the herder can still take
+            # the admitted ones
+            self.ingest.shutdown()
         if self.herder is not None:
             # cancel consensus timers before anything closes: on a shared
             # simulation clock a dead node's trigger/rebroadcast timer

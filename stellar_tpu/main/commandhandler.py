@@ -55,6 +55,7 @@ class CommandHandler:
             "trace": self.handle_trace,
             "invariants": self.handle_invariants,
             "selfcheck": self.handle_selfcheck,
+            "ingest": self.handle_ingest,
         }
 
     # -- server plumbing ----------------------------------------------------
@@ -257,7 +258,14 @@ class CommandHandler:
             tx = TransactionFrame.make_from_wire(self.app.network_id, env)
         except (XdrError, ValueError) as e:
             return {"exception": str(e)}
-        status = self.app.herder.recv_transaction(tx)
+        # admission front door (ingest/plane.py): the submission joins the
+        # current micro-batch (plus anything the overlay queued) in ONE
+        # batched signature dispatch, and may answer TRY_AGAIN_LATER from
+        # the rate-limit/surge gates without touching the herder
+        if self.app.ingest is not None:
+            status = self.app.ingest.submit_sync(tx)
+        else:
+            status = self.app.herder.recv_transaction(tx)
         out = {"status": status}
         if status == "PENDING" and self.app.overlay_manager is not None:
             self.app.overlay_manager.broadcast_message(tx.to_stellar_message())
@@ -501,6 +509,16 @@ class CommandHandler:
             "status": "not-run",
             "detail": "node booted with a fresh DB or SELFCHECK_ON_BOOT off",
         }
+
+    def handle_ingest(self, q: dict) -> dict:
+        """The admission plane's counters (ingest/plane.py): batch-size /
+        occupancy histogram stats, per-reason shed counts (badsig /
+        ratelimit / surge), verify cache-hit split, rate-limiter
+        occupancy."""
+        ing = self.app.ingest
+        if ing is None:
+            return {"status": "not-built"}
+        return ing.stats()
 
     def handle_generateload(self, q: dict) -> dict:
         from ..simulation.loadgen import LoadGenerator

@@ -303,6 +303,32 @@ class Config:
         # (`python -m stellar_tpu.scenarios --kill-sweep`) depends on
         # it; off is for harnesses that rebuild state wholesale.
         self.SELFCHECK_ON_BOOT = True
+        # TPU-native addition: verify-at-ingest admission plane
+        # (ingest/plane.py) — submitted (/tx) and flooded (overlay) txs
+        # accumulate into size/deadline-bounded micro-batches that ride
+        # the SAME SigBackend dispatch as the close path under their own
+        # CALLER_INGEST wedge latch; valid verdicts latch into the shared
+        # verify cache (close/prewarm flushes read all-hits), invalid-sig
+        # txs shed at the edge before check_valid/account loads/flood
+        # fan-out.  Off = reference-style per-tx submission; the
+        # differential suite (tests/test_ingest.py) runs both and
+        # compares ledger hashes.
+        self.INGEST_BATCH = True
+        # accumulator bounds: flush at INGEST_BATCH_MAX queued txs or
+        # INGEST_BATCH_DEADLINE_MS after the first enqueue, whichever
+        # comes first (/tx and loadgen submits flush synchronously and
+        # carry whatever the overlay has queued along with them)
+        self.INGEST_BATCH_MAX = 256
+        self.INGEST_BATCH_DEADLINE_MS = 50
+        # admission control (0 = off for both): per-source-account
+        # token-bucket rate limit (tx/s + burst) and the surge high-water
+        # mark — when herder-pending + queued txs reach it, the lowest
+        # fee-per-min-fee tx loses its seat (surge_pricing_filter's
+        # ordering generalized to the front door); both answer
+        # TRY_AGAIN_LATER
+        self.INGEST_RATE_LIMIT = 0
+        self.INGEST_RATE_BURST = 32
+        self.INGEST_SURGE_HIGH_WATER = 0
 
     # -- loading -----------------------------------------------------------
     @classmethod
@@ -457,6 +483,45 @@ class Config:
                 f"CLOSE_PIPELINE_DEPTH must be an int >= 1, "
                 f"got {self.CLOSE_PIPELINE_DEPTH!r}"
             )
+        if not (
+            isinstance(self.INGEST_BATCH, bool)
+            or self.INGEST_BATCH in (0, 1)
+        ):
+            raise ValueError(
+                f"INGEST_BATCH must be a boolean, got {self.INGEST_BATCH!r}"
+            )
+        if not (
+            isinstance(self.INGEST_BATCH_MAX, int)
+            and not isinstance(self.INGEST_BATCH_MAX, bool)
+            and self.INGEST_BATCH_MAX >= 1
+        ):
+            raise ValueError(
+                f"INGEST_BATCH_MAX must be an int >= 1, "
+                f"got {self.INGEST_BATCH_MAX!r}"
+            )
+        if not (
+            isinstance(self.INGEST_BATCH_DEADLINE_MS, (int, float))
+            and not isinstance(self.INGEST_BATCH_DEADLINE_MS, bool)
+            and self.INGEST_BATCH_DEADLINE_MS >= 0
+        ):
+            raise ValueError(
+                f"INGEST_BATCH_DEADLINE_MS must be a number >= 0, "
+                f"got {self.INGEST_BATCH_DEADLINE_MS!r}"
+            )
+        for knob in (
+            "INGEST_RATE_LIMIT",
+            "INGEST_RATE_BURST",
+            "INGEST_SURGE_HIGH_WATER",
+        ):
+            v = getattr(self, knob)
+            if not (
+                isinstance(v, int)
+                and not isinstance(v, bool)
+                and v >= 0
+            ):
+                raise ValueError(
+                    f"{knob} must be an int >= 0 (0 = off), got {v!r}"
+                )
 
     def to_short_string(self, pk: PublicKey) -> str:
         s = PubKeyUtils.to_strkey(pk)

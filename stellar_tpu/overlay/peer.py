@@ -488,7 +488,17 @@ class Peer:
         if not om.recv_flooded_msg(msg, self):
             return  # duplicate
         tx = TransactionFrame.make_from_wire(self.app.network_id, msg.value)
-        if self.app.herder.recv_transaction(tx) == TX_STATUS_PENDING:
+        ingest = getattr(self.app, "ingest", None)
+        if ingest is not None:
+            # admission front door: the tx joins the current micro-batch
+            # and floods onward ONLY once the batch verdict admits it —
+            # an invalid-sig flood dies here without fan-out
+            def _flood_on_accept(status, _msg=msg, _om=om):
+                if status == TX_STATUS_PENDING:
+                    _om.broadcast_message(_msg)
+
+            ingest.submit(tx, on_status=_flood_on_accept)
+        elif self.app.herder.recv_transaction(tx) == TX_STATUS_PENDING:
             om.broadcast_message(msg)
 
     def recv_get_scp_quorum_set(self, msg: StellarMessage) -> None:

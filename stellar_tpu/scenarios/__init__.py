@@ -12,6 +12,7 @@ from .faults import (  # noqa: F401
     ByzantineFlood,
     CrashRestart,
     Fault,
+    IngestFlood,
     OverloadStorm,
     Partition,
     PartitionUntilCheckpoint,
@@ -31,6 +32,7 @@ __all__ = [
     "ByzantineFlood",
     "CrashRestart",
     "Fault",
+    "IngestFlood",
     "OverloadStorm",
     "SlowReader",
     "Partition",

@@ -654,6 +654,140 @@ class ByzantineFlood(Fault):
 
 
 @dataclass
+class IngestFlood(Fault):
+    """Byzantine invalid-signature TRANSACTION flood through the verify-
+    at-ingest front door (ISSUE r20), against ``target``'s admission
+    plane, between ``at`` and ``until`` on a ``tick`` cadence — mixed
+    with the spec's legitimate LoadGenerator stream at a multiple of its
+    arrival rate.
+
+    Every flooded tx is a structurally-plausible payment FROM THE
+    EXISTING ROOT ACCOUNT whose signature is corrupted after signing:
+    the root account hint-matches, so ``candidate_signature_pairs`` is
+    non-empty and the admission plane's edge shed is the defense that
+    must fire (metered ``ingest.reject.badsig``) — before check_valid,
+    account loads, or flood fan-out spend anything.  (ByzantineFlood's
+    tx flood uses NONEXISTENT accounts, which die in check_valid before
+    any signature work; this class attacks the signature plane itself.)
+
+    The fault records every corrupted triple's verify-cache key:
+    ``assert_cache_unpolluted`` pins the valid-only latch contract at
+    the admission plane — a flood of distinct invalid-sig txs latches
+    NOTHING into the shared cache, so it can never evict honest entries
+    from the bounded LRU."""
+
+    at: float
+    until: float
+    target: int = 0
+    txs_per_tick: int = 100
+    tick: float = 0.25
+
+    def __post_init__(self):
+        self.n_txs = 0
+        self._cache_keys: List[bytes] = []
+        self._scn = None
+
+    def arm(self, scn) -> None:
+        self._scn = scn
+        self._rng = random.Random(scn.spec.seed ^ 0x1609E57)
+        self._at(scn, self.at, lambda: self._tick_fn(scn), slot='tick')
+
+    def _tick_fn(self, scn) -> None:
+        if scn.elapsed_since_arm() >= self.until or scn.done:
+            return
+        app = scn.sim.nodes.get(
+            scn.sim._raw_key(scn.node_keys[self.target])
+        )
+        if app is not None and getattr(app, "ingest", None) is not None:
+            for _ in range(self.txs_per_tick):
+                self._inject_tx(app)
+        self._at(scn, self.tick, lambda: self._tick_fn(scn), slot='tick')
+
+    def _inject_tx(self, app) -> None:
+        from ..crypto.keys import SecretKey, verify_cache
+        from ..tx import testutils as T
+        from ..tx.frame import TransactionFrame
+        import stellar_tpu.xdr as X
+
+        root = T.root_key_for(app)
+        dst = SecretKey.pseudo_random_for_testing(
+            60_000_000 + self._rng.randrange(1 << 30)
+        )
+        tx = X.Transaction(
+            sourceAccount=root.get_public_key(),
+            fee=100,
+            seqNum=self._rng.randrange(1, 1 << 40),
+            timeBounds=None,
+            memo=X.Memo.none(),
+            operations=[T.payment_op(dst, 1)],
+            ext=0,
+        )
+        frame = TransactionFrame(
+            app.network_id, X.TransactionEnvelope(tx, [])
+        )
+        frame.add_signature(root)
+        sig = bytearray(frame.envelope.signatures[0].signature)
+        sig[0] ^= 0xFF
+        frame.envelope.signatures[0].signature = bytes(sig)
+        self._cache_keys.append(
+            verify_cache().key_for(
+                root.public_raw, bytes(sig), frame.get_contents_hash()
+            )
+        )
+        app.ingest.submit(frame)
+        self.n_txs += 1
+
+    # -- oracles -------------------------------------------------------------
+    def verify_outcome(self, failures: List[str]) -> None:
+        """Every injected tx must have been shed at the ingest edge: the
+        root source hint-matches, so the candidate triples are non-empty
+        and all-invalid — a leak means signature work (or worse, a queue
+        seat) was spent on provably-unauthorized traffic."""
+        if self.n_txs == 0:
+            failures.append("ingest_flood: no flood txs were injected")
+            return
+        planes = [
+            app.ingest
+            for app in self._scn.sim.nodes.values()
+            if getattr(app, "ingest", None) is not None
+        ]
+        if not planes:
+            failures.append("ingest_flood: no node built an IngestPlane")
+            return
+        for p in planes:
+            # drain a final partial batch so every injected tx is decided
+            # (the scoreboard snapshot already closed; this only feeds
+            # the exact-count oracle below)
+            p.flush_now()
+        shed = sum(p.m_reject_badsig.count for p in planes)
+        if shed != self.n_txs:
+            failures.append(
+                "ingest_flood: %d invalid-sig txs injected but %d shed at"
+                " the edge — the admission plane leaked or double-counted"
+                % (self.n_txs, shed)
+            )
+
+    def assert_cache_unpolluted(self) -> int:
+        """The shared verify cache must hold NO verdict for any flooded
+        invalid-sig tx triple — the valid-only latch contract at the
+        admission plane.  Returns how many keys were checked."""
+        from ..crypto.keys import verify_cache
+
+        latched = [
+            v for v in verify_cache().peek_many(self._cache_keys)
+            if v is not None
+        ]
+        if latched:
+            raise AssertionError(
+                "%d/%d flooded invalid-sig ingest txs latched a verdict"
+                " in the shared verify cache — the valid-only latch"
+                " contract broke at the admission plane"
+                % (len(latched), len(self._cache_keys))
+            )
+        return len(self._cache_keys)
+
+
+@dataclass
 class SlowReader(Fault):
     """The overlay survival plane's defining adversary (ISSUE r17): one
     peer drains its links at a fraction of the offered rate — the

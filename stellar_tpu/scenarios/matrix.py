@@ -76,6 +76,14 @@ Fault classes (ROADMAP #5 / ISSUE r12 acceptance):
                           verify kernel; tier-1 runs the XLA-CPU oracle
                           and the CALLER_OVERLAY wedge-latch contract is
                           pinned under flood
+- ``ingest_flood``      — sustained LoadGenerator stream + byzantine
+                          invalid-sig TX flood through the verify-at-
+                          ingest front door at 10x the legit arrival
+                          rate (ISSUE r20): every flooded tx sheds at
+                          the edge (ingest.reject.badsig) before
+                          check_valid or fan-out, the verify cache
+                          stays clean (valid-only latch), the liveness
+                          floor holds, two-run deterministic replay
 - ``tcp_scale``         — the 100+ node core-and-tier shape OVER REAL
                           TCP SOCKETS (big matrix / -m slow only): the
                           sendqueue + pack-once fan-out planes at
@@ -94,6 +102,7 @@ from .faults import (
     ClockSkew,
     CrashRestart,
     HardKillMidClose,
+    IngestFlood,
     OverloadStorm,
     Partition,
     PartitionUntilCheckpoint,
@@ -107,6 +116,7 @@ FAULT_CLASSES = (
     "byzantine_flood",
     "byzantine_flood_halfagg",
     "byzantine_flood_tpu",
+    "ingest_flood",
     "slow_lossy",
     "crash_restart",
     "hard_kill_mid_close",
@@ -437,6 +447,31 @@ def small_specs(seed: int = 1) -> Dict[str, ScenarioSpec]:
             min_ledgers_per_sec=0.2,
             timeout=180.0,
         ),
+        # the admission-plane flood leg (ISSUE r20): the LoadGenerator's
+        # legit stream (40 tx/s) keeps flowing while a byzantine flood
+        # of invalid-sig txs FROM THE EXISTING ROOT ACCOUNT (so the
+        # candidate triples hint-match and the edge shed — not
+        # check_valid — is the defense that fires) hits node 0's ingest
+        # front door at 400 tx/s, 10x the legit rate.  Every flooded tx
+        # must shed at the edge (spec floor + the fault's exact-count
+        # oracle), the verify cache stays clean, and the close cadence
+        # holds the same floor as the un-flooded shapes.
+        "ingest_flood": ScenarioSpec(
+            name="ingest_flood_small",
+            fault_class="ingest_flood",
+            n_nodes=3,
+            seed=seed,
+            faults=[
+                IngestFlood(
+                    at=0.5, until=7.0, target=0,
+                    txs_per_tick=100, tick=0.25,
+                )
+            ],
+            min_ingest_sheds=2000,
+            target_ledgers=14,
+            min_ledgers_per_sec=0.2,
+            timeout=180.0,
+        ),
         "catchup_load": ScenarioSpec(
             name="catchup_load_small",
             fault_class="catchup_load",
@@ -545,6 +580,14 @@ def big_specs(seed: int = 1) -> Dict[str, ScenarioSpec]:
                     envelopes_per_tick=50, txs_per_tick=10, tick=0.4,
                 )
             ]
+        elif cls == "ingest_flood":
+            big.faults = [
+                IngestFlood(
+                    at=0.5, until=20.0, target=0,
+                    txs_per_tick=200, tick=0.25,
+                )
+            ]
+            big.min_ingest_sheds = 10_000
         elif cls in ("clock_skew_within_slip", "clock_skew_beyond_slip"):
             # node 2 is a core node in the 4+4 shape; the core's 3-of-4
             # majority absorbs a beyond-slip stall exactly like the

@@ -101,6 +101,14 @@ class ScenarioSpec:
     # summed across nodes) — the skew classes' observable
     min_slip_rejects: int = 0
     max_slip_rejects: Optional[int] = None
+    # verify-at-ingest admission plane (ISSUE r20): per-node Config
+    # overrides for the front door's admission knobs (None keeps the
+    # Config defaults), and the flood shape's floor — the run must shed
+    # at least this many invalid-sig txs at the ingest edge (metered
+    # ingest.reject.badsig, summed across nodes)
+    ingest_rate_limit: Optional[int] = None
+    ingest_surge_high_water: Optional[int] = None
+    min_ingest_sheds: int = 0
     # per-tier scoreboard aggregates: {tier_name: [node indices]} —
     # report-only grouping (targeted faults read "tier-1 undisturbed,
     # tier-2 shed" off it)
@@ -195,6 +203,10 @@ class Scenario:
             cfg.OVERLAY_SENDQ_FLOOD_MSGS = self.spec.sendq_flood_msgs
         if self.spec.straggler_stall_ms is not None:
             cfg.STRAGGLER_STALL_MS = self.spec.straggler_stall_ms
+        if self.spec.ingest_rate_limit is not None:
+            cfg.INGEST_RATE_LIMIT = self.spec.ingest_rate_limit
+        if self.spec.ingest_surge_high_water is not None:
+            cfg.INGEST_SURGE_HIGH_WATER = self.spec.ingest_surge_high_water
         if self.spec.disk_db or self.spec.archives:
             cfg.DATABASE = f"sqlite3://{self.workdir}/node{i}.db"
         if self.spec.archives:
@@ -382,6 +394,20 @@ class Scenario:
                     "%d time-slip rejections metered against a ceiling"
                     " of %d — a within-slip skew must not trip the gate"
                     % (total_slip, spec.max_slip_rejects)
+                )
+            # ingest-edge verdict (ISSUE r20): the flood shapes must have
+            # shed their invalid-sig txs at the admission plane — before
+            # check_valid, account loads, or flood fan-out spent anything
+            if spec.min_ingest_sheds and (
+                sb.ingest_rejects.get("badsig", 0) < spec.min_ingest_sheds
+            ):
+                failures.append(
+                    "expected >= %d invalid-sig txs shed at the ingest"
+                    " edge, got %d"
+                    % (
+                        spec.min_ingest_sheds,
+                        sb.ingest_rejects.get("badsig", 0),
+                    )
                 )
             # overlay survival plane verdicts — CRITICAL is never shed,
             # in ANY scenario (the tentpole contract)

@@ -84,6 +84,17 @@ def _node_counters(app) -> Dict[str, int]:
     else:
         out.update({"sendq." + k: 0 for k in _SENDQ_DELTA_KEYS})
         out.update({"sendq." + k: 0 for k in _SENDQ_MAX_KEYS})
+    ing = getattr(app, "ingest", None)
+    out.update({
+        # verify-at-ingest admission plane (ingest/plane.py, ISSUE r20):
+        # edge sheds per reject class + admitted txs + flush count, all
+        # crank-deterministic (the badsig sheds join the digest)
+        "ingest.reject_badsig": ing.m_reject_badsig.count if ing else 0,
+        "ingest.reject_ratelimit": ing.m_reject_rate.count if ing else 0,
+        "ingest.reject_surge": ing.m_reject_surge.count if ing else 0,
+        "ingest.admitted": ing.m_admit.count if ing else 0,
+        "ingest.flushes": ing.m_flush.count if ing else 0,
+    })
     out.update({
         "recv_load_sheds": (
             om.load_manager.n_sheds
@@ -174,6 +185,14 @@ class LivenessScoreboard:
     sendq_bytes_high_water: int = 0
     sendq_max_stall_ms: float = 0.0
     recv_load_sheds: int = 0  # LoadManager (receive-cost) shed decisions
+    # verify-at-ingest admission plane (ingest/plane.py, ISSUE r20):
+    # edge sheds per reject class (window deltas; badsig is the flood
+    # defense and joins the virtual-mode digest), admitted txs, and the
+    # standing per-pod line-rate claim — rejects/sec over the window
+    ingest_rejects: Dict[str, int] = field(default_factory=dict)
+    ingest_admitted: int = 0
+    ingest_flushes: int = 0
+    ingest_reject_rate_per_sec: float = 0.0
     # close pipeline (reported, excluded from digest: thread timing)
     pipeline: Dict[str, float] = field(default_factory=dict)
     # SCP signature-scheme plane (reported, excluded from digest: wall
@@ -287,6 +306,19 @@ class LivenessScoreboard:
         sb.recv_load_sheds = sum(
             d.get("recv_load_sheds", 0) for d in deltas
         )
+        for short, key in (
+            ("badsig", "ingest.reject_badsig"),
+            ("ratelimit", "ingest.reject_ratelimit"),
+            ("surge", "ingest.reject_surge"),
+        ):
+            sb.ingest_rejects[short] = sum(d.get(key, 0) for d in deltas)
+        sb.ingest_admitted = sum(
+            d.get("ingest.admitted", 0) for d in deltas
+        )
+        sb.ingest_flushes = sum(d.get("ingest.flushes", 0) for d in deltas)
+        sb.ingest_reject_rate_per_sec = round(
+            sum(sb.ingest_rejects.values()) / sb.wall_seconds, 2
+        )
         if tiers:
             for tier, members in tiers.items():
                 tier_closed = [
@@ -361,6 +393,10 @@ class LivenessScoreboard:
                 # are pure functions of the shared virtual clock)
                 slip_rejects_past=self.slip_rejects_past,
                 slip_rejects_future=self.slip_rejects_future,
+                # ingest-edge sheds ride the same crank-determinism as
+                # fast_rejects: injection timers, deadline flushes, and
+                # size triggers are pure functions of the virtual clock
+                ingest_rejects=dict(sorted(self.ingest_rejects.items())),
             )
         return sha256(
             json.dumps(stable, sort_keys=True).encode()

@@ -202,6 +202,9 @@ class LoadGenerator:
 
     @staticmethod
     def _herder_pending(app) -> int:
+        herder = app.herder
+        if hasattr(herder, "num_pending_txs"):
+            return herder.num_pending_txs()
         return sum(
             len(txmap.transactions)
             for gen in app.herder.received_transactions
@@ -222,7 +225,14 @@ class LoadGenerator:
     def _submit(self, app, tx) -> bool:
         from ..herder.herder import TX_STATUS_PENDING
 
-        status = app.herder.recv_transaction(tx)
+        # ride the admission front door when the node has one: loadgen
+        # traffic shares the micro-batch (and the rate/surge gates) with
+        # the overlay flood, exactly like a real submitter would
+        ingest = getattr(app, "ingest", None)
+        if ingest is not None:
+            status = ingest.submit_sync(tx)
+        else:
+            status = app.herder.recv_transaction(tx)
         if status != TX_STATUS_PENDING:
             log.debug("loadgen tx rejected: %s", status)
             return False

@@ -21,6 +21,14 @@ class HalfAggScheme:
         self.cache.put_many((k, True) for k in keys)
 
 
+class IngestPlane:
+    def flush_now(self, keys, fresh):
+        # the admission flush's valid-only latch (r20): the fifth
+        # sanctioned latch class — synchronous on the caller's crank,
+        # only True verdicts pass the filter
+        self.cache.put_many((k, ok) for k, ok in zip(keys, fresh) if ok)
+
+
 def read_only(cache, keys):
     return cache.peek_many(keys)
 

@@ -12,3 +12,10 @@ def sneak_verdicts(key, pairs):
 
 def sneak_evict(keys):
     verify_cache().drop_many(keys)
+
+
+class IngestHelper:
+    # NOT IngestPlane: a helper class next to the admission plane has no
+    # license to latch — only the plane's own flush does (r20)
+    def latch_from_helper(self, pairs):
+        self.cache.put_many(pairs)

@@ -53,7 +53,7 @@ def rules_hit(report: Report):
 RULE_FIXTURES = [
     ("cow-mutation", "cow_mutation_pos.py", "cow_mutation_neg.py", 7),
     ("trusted-getfield", "trusted_getfield_pos.py", "trusted_getfield_neg.py", 3),
-    ("cache-latch", "cache_latch_pos.py", "cache_latch_neg.py", 3),
+    ("cache-latch", "cache_latch_pos.py", "cache_latch_neg.py", 4),
     ("locked-field", "locked_field_pos.py", "locked_field_neg.py", 3),
     ("determinism", "determinism_pos.py", "determinism_neg.py", 6),
     ("metrics-fast-lane", "metrics_fast_lane_pos.py", "metrics_fast_lane_neg.py", 5),

@@ -176,6 +176,11 @@ def test_bench_close_subprocess_success_path():
     # boot self-check cost (ISSUE r18) rides every close line so a
     # selfcheck regression is visible without a real restart
     assert out["selfcheck_ms"] >= 0
+    # verify-at-ingest admission plane (ISSUE r20): the standing
+    # flood-defense leg must shed its whole hint-matching invalid-sig
+    # flood at the edge, in full size-trigger batches
+    assert out["ingest_rejects_per_sec"] > 0
+    assert 0 < out["ingest_batch_occupancy"] <= 1.0
 
 
 def test_probe_tpu_alive_success_path(monkeypatch):

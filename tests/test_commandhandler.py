@@ -22,7 +22,7 @@ EXPECTED_ROUTES = {
     "manualclose", "metrics", "peers", "setcursor", "scp",
     "testacc", "testtx", "tx",
     # TPU-native extras beyond the reference's table
-    "profiler", "trace", "invariants", "selfcheck",
+    "profiler", "trace", "invariants", "selfcheck", "ingest",
 }
 
 

@@ -368,12 +368,41 @@ def test_byzantine_flood_tpu_small():
         assert isinstance(s.get("wedge_latch_flips", {}), dict)
 
 
+def test_ingest_flood_small():
+    """The admission-plane flood leg (ISSUE r20): the LoadGenerator's
+    legit stream keeps flowing while an invalid-sig tx flood FROM THE
+    EXISTING ROOT ACCOUNT hits node 0's ingest front door at 10x the
+    legit arrival rate.  Every flooded tx is shed AT THE EDGE (metered
+    ingest.reject.badsig, before check_valid/account loads/fan-out —
+    the fault's verify_outcome pins the exact count), the shared verify
+    cache stays provably clean of flood verdicts (valid-only latch),
+    legit txs keep externalizing through the same front door, and the
+    close cadence holds the same floor as the un-flooded shapes."""
+    verify_cache().clear()
+    spec = small_specs()["ingest_flood"]
+    flood = spec.faults[0]
+    from stellar_tpu.scenarios.scenario import Scenario
+
+    r = Scenario(spec).run()
+    assert r.ok, r.failures
+    sb = r.scoreboard
+    assert sb.ledgers_closed >= 10
+    assert flood.n_txs >= 2000  # the flood genuinely ran at 10x load
+    assert sb.ingest_rejects["badsig"] >= spec.min_ingest_sheds
+    assert sb.ingest_reject_rate_per_sec > 0  # the per-pod line-rate claim
+    assert sb.ingest_admitted > 0  # legit load flowed through the door
+    assert sb.invariant_violations == 0
+    assert sb.ledgers_agree and sb.final_hash
+    assert flood.assert_cache_unpolluted() == flood.n_txs
+
+
 @pytest.mark.parametrize(
     "cls",
     [
         "partition_heal",
         "byzantine_flood",
         "byzantine_flood_halfagg",
+        "ingest_flood",
         "slow_lossy",
         "crash_restart",
         "hard_kill_mid_close",
