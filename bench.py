@@ -1564,6 +1564,14 @@ def bench_ledger_close(n_txs=5000, n_ledgers=3):
         # closes above, but measured in the same process/window
         ingest_rps, ingest_occ = _measure_ingest_admission(app)
 
+        # parallel-apply scheduler counters (ISSUE r21): memoized on the
+        # manager by the first PARALLEL_APPLY close attempt; absent means
+        # the knob was off for the whole window
+        from stellar_tpu.ledger.applysched import ApplyScheduler
+
+        sched = getattr(lm, "_apply_sched", None)
+        sched_stats = sched.stats if sched is not None else ApplyScheduler(lm).stats
+
         times.sort()
         p50 = statistics.median(times)
         p95 = times[min(len(times) - 1, int(0.95 * len(times)))]
@@ -1599,6 +1607,21 @@ def bench_ledger_close(n_txs=5000, n_ledgers=3):
             "xdr_copies_per_tx": round(d_copies / n_applied, 2),
             "cow_seals_per_tx": round(d_seals / n_applied, 2),
             "cow_copies_per_tx": round(d_unseals / n_applied, 2),
+            # conflict-partitioned parallel apply (ISSUE r21,
+            # ledger/applysched.py): effective worker count of the last
+            # sharded close (0 = every close ran the serial loop — e.g.
+            # a 1-core host auto-sizing to one worker), the fraction of
+            # txs applied inside parallel groups, and how many sets fell
+            # back serial on CONFLICTING classification or escape
+            "apply_workers": sched_stats["workers"],
+            "apply_parallel_pct": (
+                round(
+                    100.0 * sched_stats["parallel_txs"]
+                    / sched_stats["total_txs"], 1
+                )
+                if sched_stats["total_txs"] else 0.0
+            ),
+            "apply_conflict_fallbacks": sched_stats["conflict_fallbacks"],
             # close pipeline (ISSUE r10): verify wall hidden inside the
             # previous close's apply, and the lookahead depth it ran at
             "overlap_hidden_ms": (

@@ -211,6 +211,18 @@ FINAL_STEPS = [
       "--only", "ingest_flood",
       "--json"],
      1800),
+    # r21: conflict-partitioned parallel apply — paired same-window
+    # PARALLEL_APPLY on/off A/B on the pair-destination payment shape
+    # (n/2 disjoint groups), PARANOID + invariants all-on both legs,
+    # hashes/SQL/metas asserted bit-exact, per-shard occupancy table +
+    # conflict-fallback ledger printed.  Exits 1 when the parallel leg
+    # never shards, or (on a >=4-core host) when the apply-phase wall
+    # cut misses the >=1.5x @ 4 workers acceptance; on fewer cores the
+    # per-call accounting is the evidence (paired-measurement policy).
+    ("parallel_apply_r21",
+     [sys.executable, "-u", "profile_close.py", "--apply-report",
+      "5000", "3", "4"],
+     2400),
 ]
 ALL_NAMES = (
     [s[0] for s in SCRIPT_STEPS]

@@ -586,6 +586,15 @@ class LedgerManager:
         from ..tx import history as tx_history
         from ..xdr.txs import TransactionResultCode
 
+        if self.app.config.PARALLEL_APPLY:
+            from .applysched import apply_scheduler_of
+
+            # conflict-partitioned parallel apply; False means the set was
+            # not touched (CONFLICTING classification, too few groups, or
+            # a footprint escape) and the serial loop below is the truth
+            if apply_scheduler_of(self).apply(txs, ledger_delta, tx_result_set):
+                return
+
         rows = []
         seq = self.current.header.ledgerSeq
         for index, tx in enumerate(txs, start=1):

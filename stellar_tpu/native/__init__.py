@@ -293,6 +293,33 @@ def load_halfagg():
         )
         return _halfagg_mod
 
+# -- applycore: the parallel-apply host leg (CPython extension) --------------
+
+_APPLYCORE_SRC = os.path.join(_HERE, "applycore.c")
+_APPLYCORE_SO = os.path.join(_HERE, "_applycore.so")
+
+_applycore_lock = threading.Lock()
+_applycore_mod = None
+_applycore_tried = False
+
+
+def load_applycore():
+    """The compiled parallel-apply host leg
+    (``encode_history_rows(items)``), or None (ledger/applysched.py
+    falls back to per-row ``base64``/``hex`` in Python — correct, but
+    the worker shards then serialize on the GIL through the encode
+    tail)."""
+    global _applycore_mod, _applycore_tried
+    with _applycore_lock:
+        if _applycore_mod is not None or _applycore_tried:
+            return _applycore_mod
+        _applycore_tried = True
+        _applycore_mod = _load_extension(
+            "_applycore", _APPLYCORE_SRC, _san_so(_APPLYCORE_SO)
+        )
+        return _applycore_mod
+
+
 _sighash_lock = threading.Lock()
 _sighash_mod = None
 _sighash_tried = False

@@ -1,0 +1,17 @@
+# analysis-fixture-path: ledger/apply_shard_fixture.py
+# NEGATIVE: a compliant worker leg (every plane arrives as a parameter)
+# and an unregistered merge step that may legally touch the main store.
+
+
+def _run_shard(shard_db, shard_app, jobs, outcomes, errors):  # analysis: shard-leg
+    try:
+        for idx, tx in jobs:
+            outcomes[idx] = tx.apply_against(shard_db, shard_app)
+    except BaseException as e:  # noqa: BLE001 - re-raised on the main thread
+        errors.append(e)
+
+
+def merge_shards(db, rows):
+    # not a shard-leg: runs on the main thread after the join barrier
+    db.executemany("INSERT INTO txhistory VALUES (?, ?, ?)", rows)
+    return db.query_one("SELECT COUNT(*) FROM txhistory")

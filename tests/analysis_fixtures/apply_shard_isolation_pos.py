@@ -1,0 +1,19 @@
+# analysis-fixture-path: ledger/apply_shard_fixture.py
+# POSITIVE: main-plane dependencies inside registered shard-leg workers,
+# plus a marker that floats off its `def` line.
+from stellar_tpu.ledger.entryframe import entry_cache_of
+
+
+def _run_shard(self, jobs, outcomes):  # analysis: shard-leg
+    db = self.app.database                   # main plane off the app
+    row = db.query_one("SELECT 1")           # SQL bypasses the shard overlay
+    cache = entry_cache_of(db)               # resolves the MAIN cache
+    for idx, tx in jobs:
+        outcomes[idx] = (tx, row, cache)
+
+
+def _merge(self, shards):
+    # analysis: shard-leg
+    # the marker above registers nothing: it must sit on a `def` line
+    for shard in shards:
+        shard.close_view()

@@ -1,0 +1,195 @@
+"""Unit tests for the r21 parallel-apply scheduler internals.
+
+The end-to-end bit-exactness proof lives in tests/test_framecontext.py
+(every differential scenario knob-on/off + the engagement/fallback
+white-box test) and tests/test_scenarios.py (chaos-class deterministic
+replay).  This file pins the pieces in isolation: footprint
+classification, the union-find partition, the greedy shard packing, and
+the FootprintEscape fences on the shard planes."""
+
+import types
+
+import pytest
+
+import stellar_tpu.xdr as X
+from stellar_tpu.ledger.applysched import (
+    ApplyScheduler,
+    FootprintEscape,
+    ShardEntryCache,
+    ShardStoreBuffer,
+)
+from stellar_tpu.ledger.storebuffer import EntryStoreBuffer
+from stellar_tpu.tx import testutils as T
+from stellar_tpu.tx.frame import TransactionFrame, _acct_kb
+
+NET = b"\x07" * 32
+
+
+def frame(source, ops):
+    tx = X.Transaction(
+        sourceAccount=source.get_public_key(),
+        fee=100 * max(1, len(ops)),
+        seqNum=1,
+        timeBounds=None,
+        memo=X.Memo.none(),
+        operations=ops,
+        ext=0,
+    )
+    return TransactionFrame(NET, X.TransactionEnvelope(tx, []))
+
+
+A, B, C = (T.get_account("fp-%d" % i) for i in range(3))
+
+
+# -- static_footprint classification ----------------------------------------
+
+
+def test_footprint_bounded_ops():
+    fp = frame(A, [T.payment_op(B, 5)]).static_footprint()
+    assert fp == {_acct_kb(A.get_public_key()), _acct_kb(B.get_public_key())}
+    fp = frame(A, [T.create_account_op(B, 10**10)]).static_footprint()
+    assert fp == {_acct_kb(A.get_public_key()), _acct_kb(B.get_public_key())}
+    fp = frame(A, [T.merge_op(B)]).static_footprint()
+    assert fp == {_acct_kb(A.get_public_key()), _acct_kb(B.get_public_key())}
+    # plain set_options touches only the source
+    fp = frame(A, [T.set_options_op(master_weight=2)]).static_footprint()
+    assert fp == {_acct_kb(A.get_public_key())}
+    # an op-level source widens the footprint
+    fp = frame(A, [T.payment_op(B, 5, source=C)]).static_footprint()
+    assert _acct_kb(C.get_public_key()) in fp and len(fp) == 3
+
+
+def test_footprint_unbounded_ops_classify_conflicting():
+    cny = X.Asset.alphanum4(b"CNY\x00", C.get_public_key())
+    price = X.Price(1, 1)
+    unbounded = [
+        [T.payment_op(B, 5, asset=cny)],
+        [T.path_payment_op(B, X.Asset.native(), 10, X.Asset.native(), 10, [])],
+        [T.manage_offer_op(X.Asset.native(), cny, 100, price)],
+        [T.create_passive_offer_op(X.Asset.native(), cny, 100, price)],
+        [T.change_trust_op(cny, 10**9)],
+        [T.allow_trust_op(B, b"CNY\x00", True)],
+        [T.inflation_op()],
+        [T.set_options_op(inflation_dest=B.get_public_key())],
+        # one bad op poisons an otherwise-bounded tx
+        [T.payment_op(B, 5), T.inflation_op()],
+    ]
+    for ops in unbounded:
+        assert frame(A, ops).static_footprint() is None, ops
+
+
+# -- partition ---------------------------------------------------------------
+
+
+def sched():
+    return ApplyScheduler(None)  # _partition/_assign never touch the lm
+
+
+def test_partition_disjoint_pairs_and_chains():
+    accts = [T.get_account("pt-%d" % i) for i in range(8)]
+    # XOR pairs: (0,1) (2,3) (4,5) (6,7) -> 4 groups, canonical order
+    pairs = [frame(accts[i], [T.payment_op(accts[i ^ 1], 1)]) for i in range(8)]
+    groups = sched()._partition(pairs)
+    assert [sorted(i for i, _tx in g) for g in groups] == [
+        [0, 1], [2, 3], [4, 5], [6, 7],
+    ]
+    # group order is first-tx canonical order, tx identity preserved
+    assert groups[0][0] == (0, pairs[0]) and groups[3][1] == (7, pairs[7])
+    # a chain (i -> i+1) union-finds into ONE group
+    chain = [
+        frame(accts[i], [T.payment_op(accts[i + 1], 1)]) for i in range(7)
+    ]
+    groups = sched()._partition(chain)
+    assert len(groups) == 1 and len(groups[0]) == 7
+
+
+def test_partition_conflicting_tx_poisons_the_set():
+    txs = [
+        frame(A, [T.payment_op(B, 1)]),
+        frame(B, [T.inflation_op()]),
+    ]
+    assert sched()._partition(txs) is None
+
+
+def test_partition_is_deterministic():
+    accts = [T.get_account("dt-%d" % i) for i in range(6)]
+    txs = [frame(accts[i], [T.payment_op(accts[(i + 3) % 6], 1)]) for i in range(6)]
+    a = sched()._partition(txs)
+    b = sched()._partition(txs)
+    assert [[i for i, _ in g] for g in a] == [[i for i, _ in g] for g in b]
+
+
+# -- greedy shard packing ----------------------------------------------------
+
+
+def test_assign_balances_largest_first():
+    groups = [[None] * n for n in (5, 3, 3, 2, 2, 1)]
+    shards = sched()._assign(groups, 2)
+    loads = sorted(sum(len(groups[g]) for g in s) for s in shards)
+    assert loads == [8, 8]
+    # deterministic: same answer twice
+    assert sched()._assign(groups, 2) == shards
+
+
+def test_assign_drops_empty_shards():
+    groups = [[None], [None]]
+    shards = sched()._assign(groups, 4)
+    assert len(shards) == 2 and sorted(g for s in shards for g in s) == [0, 1]
+
+
+# -- FootprintEscape fences --------------------------------------------------
+
+
+class _FakeMainCache:
+    def __init__(self, d=None):
+        self.d = dict(d or {})
+
+    def peek(self, kb):
+        return (kb in self.d, self.d.get(kb))
+
+    def contains(self, kb):
+        return kb in self.d
+
+
+def test_shard_cache_fences_and_overlay():
+    inside, outside = b"a:in", b"a:out"
+    main = _FakeMainCache({inside: "main-entry"})
+    cache = ShardEntryCache(main, frozenset([inside]))
+    assert cache.peek(inside) == (True, "main-entry")
+    cache.put_owned(inside, "shard-entry")
+    assert cache.peek(inside) == (True, "shard-entry")
+    assert main.d[inside] == "main-entry"  # main plane never written
+    for probe in (cache.peek, cache.contains, lambda kb: cache.put_owned(kb, 1)):
+        with pytest.raises(FootprintEscape):
+            probe(outside)
+    with pytest.raises(FootprintEscape):
+        cache.clear()
+    # erase is deliberately unchecked (rollback during an escape unwind)
+    cache.erase(outside)
+    cache.erase(inside)
+    assert cache.peek(inside) == (True, "main-entry")
+
+
+def test_shard_buffer_fences_and_mark_rollback():
+    inside, outside = b"b:in", b"b:out"
+    key = types.SimpleNamespace(type=None)  # record() sniffs key.type
+    main = EntryStoreBuffer()
+    main.active = True
+    main.record(inside, key, "main-slot", None)
+    buf = ShardStoreBuffer(main, frozenset([inside]))
+    assert buf.get(inside) == (True, "main-slot")
+    buf.push_mark()
+    buf.record(inside, key, "shard-slot", None)
+    assert buf.get(inside) == (True, "shard-slot")
+    buf.rollback_mark()
+    # rolled back to the main overlay's slot, main untouched
+    assert buf.get(inside) == (True, "main-slot")
+    assert main.get(inside) == (True, "main-slot")
+    with pytest.raises(FootprintEscape):
+        buf.get(outside)
+    with pytest.raises(FootprintEscape):
+        buf.record(outside, key, "x", None)
+    with pytest.raises(FootprintEscape):
+        buf.flush(None)
+    with pytest.raises(FootprintEscape):
+        buf.flush_through(None)

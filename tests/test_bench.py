@@ -181,6 +181,14 @@ def test_bench_close_subprocess_success_path():
     # flood at the edge, in full size-trigger batches
     assert out["ingest_rejects_per_sec"] > 0
     assert 0 < out["ingest_batch_occupancy"] <= 1.0
+    # conflict-partitioned parallel apply (ISSUE r21): every close line
+    # carries the scheduler's ledger — worker count, fraction of txs
+    # applied in parallel groups, and serial fallbacks.  The 1-core CI
+    # host auto-sizes to one worker (serial short-circuit), so the pins
+    # here are presence + sanity, not a scaling claim.
+    assert out["apply_workers"] >= 0
+    assert 0.0 <= out["apply_parallel_pct"] <= 100.0
+    assert out["apply_conflict_fallbacks"] >= 0
 
 
 def test_probe_tpu_alive_success_path(monkeypatch):

@@ -49,6 +49,7 @@ class _Runner:
         "frame_context": "FRAME_CONTEXT",
         "cow": "COW_ENTRY_SNAPSHOTS",
         "close_pipeline": "CLOSE_PIPELINE",
+        "parallel_apply": "PARALLEL_APPLY",
     }
 
     def __init__(self, clock, instance_base, knob="frame_context"):
@@ -57,6 +58,11 @@ class _Runner:
         for i, on in enumerate((True, False)):
             cfg = T.get_test_config(instance_base + i)
             setattr(cfg, self.KNOBS[knob], on)
+            if knob == "parallel_apply":
+                # the 1-core CI host auto-sizes to a single worker (which
+                # short-circuits to the serial path): pin 4 so the on-leg
+                # genuinely shards, partitions, and merges
+                cfg.APPLY_WORKERS = 4
             cfg.PARANOID_MODE = True  # audit every close on both sides
             self.apps.append(Application(clock, cfg, new_db=True))
 
@@ -103,15 +109,26 @@ class _Runner:
             app.database.close()
 
 
-@pytest.fixture(params=["frame_context", "cow", "close_pipeline"])
+@pytest.fixture(
+    params=["frame_context", "cow", "close_pipeline", "parallel_apply"]
+)
 def runner(clock, request):
-    """Every differential scenario runs three times: FRAME_CONTEXT on/off,
-    COW_ENTRY_SNAPSHOTS on/off, and CLOSE_PIPELINE on/off (each vs an
-    otherwise-default config) — the aliasing planes and the pipelined
-    close share one equivalence oracle."""
+    """Every differential scenario runs four times: FRAME_CONTEXT on/off,
+    COW_ENTRY_SNAPSHOTS on/off, CLOSE_PIPELINE on/off, and PARALLEL_APPLY
+    on/off (each vs an otherwise-default config) — the aliasing planes,
+    the pipelined close, and the conflict-partitioned parallel apply all
+    share one equivalence oracle.  The parallel-apply leg covers both
+    sides of its own fork: partitionable sets shard and merge, while the
+    offer-crossing / path-payment / inflation scenarios classify
+    CONFLICTING and must fall back to the serial loop bit-exactly."""
     r = _Runner(
         clock,
-        {"frame_context": 72, "cow": 84, "close_pipeline": 96}[request.param],
+        {
+            "frame_context": 72,
+            "cow": 84,
+            "close_pipeline": 96,
+            "parallel_apply": 108,
+        }[request.param],
         knob=request.param,
     )
     yield r
@@ -257,6 +274,59 @@ def test_differential_offer_crossing(runner):
         ]),
     ])
     assert codes == [RC.txSUCCESS, RC.txSUCCESS]
+
+
+def test_parallel_apply_engages_and_falls_back(clock):
+    """White-box check on the parallel_apply runner's on-leg: a payment
+    set with disjoint sources genuinely shards (closes_parallel grows),
+    while a self path-payment classifies CONFLICTING and takes the
+    serial loop — with both legs still bit-exact (the runner asserts
+    hashes / SQL / metas after every close)."""
+    r = _Runner(clock, 110, knob="parallel_apply")
+    try:
+        a, b = T.get_account("pa-a"), T.get_account("pa-b")
+        c, d = T.get_account("pa-c"), T.get_account("pa-d")
+        r.close(lambda app, root: [
+            T.tx_from_ops(app, root, _seq(app, root), [
+                T.create_account_op(a, 10**12),
+                T.create_account_op(b, 10**12),
+                T.create_account_op(c, 10**12),
+                T.create_account_op(d, 10**12),
+            ]),
+        ])
+        codes = r.close(lambda app, root: [
+            T.tx_from_ops(app, a, _seq(app, a), [T.payment_op(b, 10**7)]),
+            T.tx_from_ops(app, c, _seq(app, c), [T.payment_op(d, 10**7)]),
+        ])
+        assert codes == [RC.txSUCCESS, RC.txSUCCESS]
+        sched = r.apps[0].ledger_manager._apply_sched
+        assert sched.stats["closes_parallel"] == 1
+        assert sched.stats["parallel_txs"] == 2
+        assert sched.stats["workers"] == 2
+        assert sched.last_close["mode"] == "parallel"
+        # a self path-payment's footprint cannot be statically bounded:
+        # the whole set must classify CONFLICTING and apply serially
+        codes = r.close(lambda app, root: [
+            T.tx_from_ops(app, a, _seq(app, a), [
+                T.op(
+                    X.OperationType.PATH_PAYMENT,
+                    X.PathPaymentOp(
+                        sendAsset=X.Asset.native(), sendMax=10**7,
+                        destination=a.get_public_key(),
+                        destAsset=X.Asset.native(), destAmount=10**7,
+                        path=[],
+                    ),
+                ),
+            ]),
+            T.tx_from_ops(app, b, _seq(app, b), [T.payment_op(c, 10**6)]),
+        ])
+        assert codes == [RC.txSUCCESS, RC.txSUCCESS]
+        assert sched.stats["conflict_fallbacks"] >= 1
+        assert sched.last_close == {
+            "mode": "serial", "reason": "conflicting-txset",
+        }
+    finally:
+        r.shutdown()
 
 
 class TestContextMechanics:

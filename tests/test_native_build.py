@@ -2,7 +2,7 @@
 
 A fresh checkout carries only the .c sources — the .so files are built on
 first use.  Until now that path was only validated by hand (PROFILE.md
-round-5 "cold-clone validation"); this builds all FOUR extensions from
+round-5 "cold-clone validation"); this builds all FIVE extensions from
 source in a temp dir with the system toolchain and runs a smoke
 differential of each against the checked-in/loaded behavior, so a
 toolchain or source regression that would only bite a cold clone fails
@@ -27,7 +27,9 @@ pytestmark = pytest.mark.skipif(
 def cold_dir(tmp_path_factory):
     d = tmp_path_factory.mktemp("coldbuild")
     src_dir = os.path.dirname(os.path.abspath(native.__file__))
-    for name in ("bucketmerge.c", "cxdrpack.c", "sighash.c", "halfagg.c"):
+    for name in (
+        "bucketmerge.c", "cxdrpack.c", "sighash.c", "halfagg.c", "applycore.c",
+    ):
         shutil.copy(os.path.join(src_dir, name), str(d / name))
     return d
 
@@ -210,6 +212,31 @@ for bad in (b"\x01" * 31, b"\x01" * 33):
     else:
         raise SystemExit("msm accepted a ragged buffer")
 
+# -- applycore: batch row encode on ragged/hostile items -------------------
+import base64
+
+apl_mod = native.load_applycore()
+assert apl_mod is not None, "applycore failed to build sanitized"
+rows = [
+    (bytes(rng.randrange(256) for _ in range(32)),
+     bytes(rng.randrange(256) for _ in range(rng.randrange(0, 400))),
+     b"", b"\xff" * 3)
+    for _ in range(40)
+]
+enc = apl_mod.encode_history_rows(rows)
+for (t, b, r, m), (ht, bb, br, bm) in zip(rows, enc):
+    assert ht == t.hex() and bb == base64.b64encode(b).decode()
+    assert br == base64.b64encode(r).decode()
+    assert bm == base64.b64encode(m).decode()
+# non-bytes / short tuples must raise cleanly, never scribble
+for bad in ([(b"x",)], [("s", b"", b"", b"")], "nope"):
+    try:
+        apl_mod.encode_history_rows(bad)
+    except (TypeError, ValueError):
+        pass
+    else:
+        raise SystemExit("applycore accepted a malformed item")
+
 # -- sodium pool leg (skipped silently when libsodium is absent) -----------
 try:
     from stellar_tpu.crypto import sodium
@@ -228,7 +255,7 @@ print("SAN_OK")
 
 @pytest.mark.slow
 def test_sanitized_build_differentials():
-    """ASan+UBSan leg: rebuild all four extensions with
+    """ASan+UBSan leg: rebuild all five extensions with
     -fsanitize=address,undefined (the STELLAR_TPU_SANITIZE plumb-through,
     separate .san.so artifacts) and run the hostile/truncated-input
     differentials inside a driver subprocess with the sanitizer runtimes
@@ -322,3 +349,37 @@ def test_sighash_cold_build_stage_differential(cold_dir):
         % ref.L
     )
     assert bytes(pc[96:128, 1]) == h.to_bytes(32, "little")
+
+
+def test_applycore_cold_build_encode_differential(cold_dir):
+    cold = native._load_extension(
+        "_applycore", str(cold_dir / "applycore.c"),
+        str(cold_dir / "_applycore.so"),
+    )
+    assert cold is not None, "applycore.c failed to compile from source"
+    import base64
+    import random
+
+    rng = random.Random(17)
+    items = [
+        (
+            bytes(rng.randrange(256) for _ in range(32)),
+            bytes(rng.randrange(256) for _ in range(rng.randrange(0, 300))),
+            bytes(rng.randrange(256) for _ in range(rng.randrange(1, 40))),
+            bytes(rng.randrange(256) for _ in range(rng.randrange(1, 120))),
+        )
+        for _ in range(50)
+    ]
+    got = cold.encode_history_rows(items)
+    want = [
+        (
+            t.hex(),
+            base64.b64encode(b).decode(),
+            base64.b64encode(r).decode(),
+            base64.b64encode(m).decode(),
+        )
+        for t, b, r, m in items
+    ]
+    assert got == want
+    warm = native.load_applycore()
+    assert warm.encode_history_rows(items) == want

@@ -322,6 +322,12 @@ def test_targeted_flood_tier2_small():
     assert sb.ledgers_agree and sb.final_hash  # tier lags, never forks
 
 
+@pytest.mark.slow  # ~126 s of XLA-CPU compile on the tier-1 host (r21
+# budget sweep): the flood/shed/cache oracles run in tier-1 on the cpu
+# backend (test_byzantine_flood_small + the halfagg leg), the wedge-latch
+# isolation contract in test_ingest/test_backend units, and the REAL-chip
+# leg rides relay_watch chaos_asymmetry_r19 — this leg's marginal value
+# is the device-shaped compile, which is exactly what makes it slow here
 def test_byzantine_flood_tpu_small():
     """The tpu-backend flood leg (ROADMAP 6(a) / ISSUE r19): the same
     byzantine flood with SIGNATURE_BACKEND="tpu" and cutover 0, so every
@@ -435,6 +441,33 @@ def test_deterministic_replay(cls):
     assert a.scoreboard.nomination_rounds == b.scoreboard.nomination_rounds
     assert a.scoreboard.ballot_rounds == b.scoreboard.ballot_rounds
     assert a.scoreboard.fast_rejects == b.scoreboard.fast_rejects
+
+
+def test_deterministic_replay_parallel_apply():
+    """ISSUE r21 satellite 4: the conflict-partitioned parallel apply
+    (ledger/applysched.py) must not perturb the replay contract — the
+    same chaos class with PARALLEL_APPLY pinned on (4 workers on every
+    node) produces identical ledger hashes AND an identical scoreboard
+    digest across two runs.  Worker interleaving is nondeterministic;
+    the canonical-order merge is what keeps it invisible."""
+    import dataclasses
+
+    from stellar_tpu.scenarios.scenario import Scenario
+
+    def once():
+        verify_cache().clear()
+        spec = dataclasses.replace(
+            small_specs()["overload_storm"], parallel_apply=True
+        )
+        r = Scenario(spec).run()
+        assert r.ok, r.failures
+        return r.scoreboard
+
+    a, b = once(), once()
+    assert a.ledgers_closed >= 10 and a.invariant_violations == 0
+    assert a.final_hash == b.final_hash
+    assert a.final_lcls == b.final_lcls
+    assert a.digest() == b.digest()
 
 
 @pytest.mark.slow
