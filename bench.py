@@ -1290,6 +1290,73 @@ def _measure_selfcheck_ms(app) -> float:
         return -1.0  # never let the diagnostic leg kill the close line
 
 
+def _measure_bucket_hash_plane(app):
+    """Paired host/device bucket-hash legs plus one representative spill
+    merge (ISSUE r22, bucket/hashplane.py).  Hashes the node's own
+    largest on-disk bucket — the timed closes produced it — through the
+    resolved host backend and, when a device kernel loads, the device
+    backend; then times a real two-bucket ``Bucket.merge``.  Returns
+    ``(mb_per_sec, merge_ms, backend_name)`` where ``mb_per_sec`` has a
+    ``host`` leg and a ``device`` leg (0.0 = that leg unavailable)."""
+    import struct
+
+    from stellar_tpu.bucket import hashplane
+    from stellar_tpu.bucket.bucket import Bucket
+
+    backend_name = hashplane.get_backend(app.config).name
+    bm = app.bucket_manager
+    data = b""
+    buckets = []
+    try:
+        for lvl in bm.bucket_list.levels:
+            for b in (lvl.curr, lvl.snap):
+                if b is not None and not b.is_empty() and b.path:
+                    buckets.append((os.path.getsize(b.path), b))
+        buckets.sort(key=lambda t: t[0], reverse=True)
+        if buckets:
+            with open(buckets[0][1].path, "rb") as f:
+                data = f.read()
+    except Exception:
+        data = b""
+    if not data:
+        # a run that closed no entries: synthetic frames keep the leg
+        # honest about the hash plane even if they are not real XDR
+        body = bytes(range(256)) * 16
+        data = (
+            struct.pack(">I", 0x80000000 | len(body)) + body
+        ) * 256
+
+    legs = {"host": 0.0, "device": 0.0}
+    for leg, name in (("host", "native"), ("device", "device")):
+        be = hashplane.backend_by_name(name)
+        if be is None and leg == "host":
+            be = hashplane.backend_by_name("hashlib")
+        if be is None:
+            continue
+        try:
+            t0 = time.perf_counter()
+            be.hash_frames(data)  # warm (device leg: compile)
+            n, total = 0, 0.0
+            while n < 3:
+                t0 = time.perf_counter()
+                be.hash_frames(data)
+                total += time.perf_counter() - t0
+                n += 1
+            legs[leg] = round(len(data) * n / total / 1e6, 1)
+        except Exception:
+            legs[leg] = 0.0  # diagnostic leg must not kill the line
+
+    merge_ms = 0.0
+    if len(buckets) >= 2:
+        try:
+            t0 = time.perf_counter()
+            Bucket.merge(bm, buckets[0][1], buckets[1][1], [], True)
+            merge_ms = round((time.perf_counter() - t0) * 1e3, 2)
+        except Exception:
+            merge_ms = 0.0
+    return legs, merge_ms, backend_name
+
+
 def _measure_ingest_admission(app, n_txs=256):
     """Standing flood-defense leg (ISSUE r20): ``n_txs`` invalid-signature
     payments from the root account through the verify-at-ingest front
@@ -1563,6 +1630,11 @@ def bench_ledger_close(n_txs=5000, n_ledgers=3):
         # flood-defense leg on every close line — untimed relative to the
         # closes above, but measured in the same process/window
         ingest_rps, ingest_occ = _measure_ingest_admission(app)
+        (
+            bucket_hash_legs,
+            bucket_merge_ms,
+            bucket_hash_backend,
+        ) = _measure_bucket_hash_plane(app)
 
         # parallel-apply scheduler counters (ISSUE r21): memoized on the
         # manager by the first PARALLEL_APPLY close attempt; absent means
@@ -1649,6 +1721,13 @@ def bench_ledger_close(n_txs=5000, n_ledgers=3):
             # loads (bucket re-hash dominates) — a boot-cost regression
             # shows up here without waiting for a real restart
             "selfcheck_ms": _measure_selfcheck_ms(app),
+            # state-plane hash pipeline (ISSUE r22): paired host/device
+            # bucket-hash throughput on this run's own largest bucket, a
+            # representative two-bucket merge wall, and the backend the
+            # closes actually resolved (bucket/hashplane.py)
+            "bucket_hash_mb_per_sec": bucket_hash_legs,
+            "bucket_merge_ms": bucket_merge_ms,
+            "bucket_hash_backend": bucket_hash_backend,
             # verify-at-ingest admission plane (ISSUE r20): edge-shed
             # throughput on a hint-matching invalid-signature flood, and
             # the mean fill of the size-trigger batches the flood packed

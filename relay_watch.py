@@ -223,6 +223,20 @@ FINAL_STEPS = [
      [sys.executable, "-u", "profile_close.py", "--apply-report",
       "5000", "3", "4"],
      2400),
+    # r22: state-plane hash pipeline.  bucket_hash_r22 is the real-chip
+    # device-vs-host bucket-hash A/B (exits 1 below 2x host throughput
+    # — on the relay the device leg is the Pallas SHA-256 kernel;
+    # profile_system.py hash_ab prints both legs and the ratio).
+    ("bucket_hash_r22",
+     [sys.executable, "-u", "profile_system.py", "hash_ab", "256"],
+     900),
+    # state_ladder_r22: the 10^6-account ladder on a multi-core window
+    # (seed + LoadGenerator-shaped closes + merge/catchup legs + 3-way
+    # backend bit-identity), recommitting STATE_LADDER_r22.json where
+    # the background merge workers actually have cores to fan over.
+    ("state_ladder_r22",
+     [sys.executable, "-u", "profile_system.py", "ladder", "1000000"],
+     3600),
 ]
 ALL_NAMES = (
     [s[0] for s in SCRIPT_STEPS]

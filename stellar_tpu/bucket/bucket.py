@@ -2,11 +2,15 @@
 (reference: src/bucket/Bucket.{h,cpp}, src/bucket/LedgerCmp.h).
 
 A bucket holds BucketEntry records (LIVEENTRY LedgerEntry | DEADENTRY
-LedgerKey) sorted by entry identity; its hash is the SHA256 of the record
-stream as written.  The two construction paths are ``fresh`` (one ledger's
-live+dead batch, Bucket.cpp:322) and ``merge`` (single-pass 2-way merge with
-shadow elision, Bucket.cpp:367-430).  ``apply`` replays a bucket into the SQL
-store for catchup-minimal (Bucket.cpp "Bucket::apply").
+LedgerKey) sorted by entry identity; its hash is the v2 state-plane hash
+(bucket/hashplane.py, ISSUE r22): SHA256 over the concatenated
+per-record digests, each digest the SHA256 of one full frame as written
+— parallelizable across device lanes / pthread tiles, unlike the raw
+stream hash it replaced.  The two construction paths are ``fresh`` (one
+ledger's live+dead batch, Bucket.cpp:322) and ``merge`` (single-pass
+2-way merge with shadow elision, Bucket.cpp:367-430).  ``apply`` replays
+a bucket into the SQL store for catchup-minimal (Bucket.cpp
+"Bucket::apply").
 
 Entry identity order is defined by (entry type, key XDR bytes) — canonical
 within this framework; hashes are framework-local, like the reference's are
@@ -19,9 +23,9 @@ import os
 import uuid
 from typing import Iterable, Iterator, List, Optional, Tuple
 
-from ..crypto import SHA256
 from ..ledger.entryframe import ledger_key_of, store_add_or_change, store_delete_key
 from ..util import fs
+from . import hashplane
 from ..util.xdrstream import XDRInputFileStream, XDROutputFileStream
 from ..xdr.base import pack_many
 from ..xdr.entries import LedgerEntry
@@ -179,17 +183,20 @@ class Bucket:
         tmp = os.path.join(
             bucket_manager.get_tmp_dir(), f"tmp-bucket-{uuid.uuid4().hex}.xdr"
         )
-        hasher = SHA256()
-        hasher.add(data)
+        # v2 state-plane hash (hashplane.py): the packed buffer's frame
+        # boundaries are walked and every record digested in batch —
+        # device lanes or the pooled C tiles, per the backend knob
+        h, count = hashplane.hash_frames(
+            data, config=bucket_manager.app.config
+        )
+        assert count == len(merged)
         # crash-safe staging (util/fs.py): write + fsync before adoption
         # renames it to the content-addressed home — a kill at any point
         # leaves either a reapable tmp or the complete file
         fs.stage_write(
             tmp, data, point=KP_FRESH, ctx=bucket_manager.app.database
         )
-        return bucket_manager.adopt_file_as_bucket(
-            tmp, hasher.finish(), len(merged)
-        )
+        return bucket_manager.adopt_file_as_bucket(tmp, h, len(merged))
 
     @staticmethod
     def merge(
@@ -279,8 +286,13 @@ def _try_native_merge(
     tmp = os.path.join(
         bucket_manager.get_tmp_dir(), f"tmp-bucket-{uuid.uuid4().hex}.xdr"
     )
-    res = native.merge_files(paths[0], paths[1], paths[2:], keep_dead_entries, tmp)
+    res = native.merge_files_v2(
+        paths[0], paths[1], paths[2:], keep_dead_entries, tmp
+    )
     if res is None:
+        # engine unavailable, merge failed, or the .so predates the v2
+        # hash symbol: the Python merge below produces the identical
+        # record stream AND the identical v2 hash
         return None
     h, count = res
     if count == 0:
@@ -307,7 +319,9 @@ def _write_merged(
     tmp = os.path.join(
         bucket_manager.get_tmp_dir(), f"tmp-bucket-{uuid.uuid4().hex}.xdr"
     )
-    hasher = SHA256()
+    # every write_one feeds the hasher exactly one full frame, which is
+    # the unit the v2 per-record-digest hash batches over
+    hasher = hashplane.BucketHasher(config=bucket_manager.app.config)
     objects = 0
     oi = _Peekable(old_it)
     ni = _Peekable(new_it)

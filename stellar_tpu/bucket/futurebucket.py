@@ -108,15 +108,26 @@ class FutureBucket:
                 self.output_hash = result.get_hash()
             self._done.set()
 
-        # run on the worker pool; completion recorded from the worker thread
-        # itself so resolve() can block without needing the main loop to crank
+        # completion is recorded from the merging thread itself so
+        # resolve() can block without needing the main loop to crank
         def run():
             try:
                 done(work())
             except BaseException as e:  # pragma: no cover
                 done(e)
 
-        app.clock._workers.submit(run)
+        # dedicated merge workers (ISSUE r22, bucket/mergeworker.py):
+        # spills merge in the background and the close boundary that
+        # commits them finds them done.  Knob off = merge synchronously
+        # right here (bit-exact differential baseline — the output hash
+        # cannot depend on WHERE the deterministic merge ran)
+        cfg = getattr(app, "config", None)
+        if cfg is None or getattr(cfg, "BACKGROUND_BUCKET_MERGE", True):
+            from . import mergeworker
+
+            mergeworker.submit(run)
+        else:
+            run()
 
     def resolve(self) -> Bucket:
         """Block until merged; flip to LIVE_OUTPUT (FutureBucket::resolve)."""

@@ -134,16 +134,17 @@ class BucketManager:
             return "missing"
         if os.path.getsize(path) == 0:
             return "corrupt"
-        from ..crypto import SHA256
+        # v2 re-hash through the state-plane pipeline (hashplane.py):
+        # per-record digests fan over device lanes / pooled C tiles, so
+        # the boot self-check's full-tree re-hash scales with cores —
+        # and a frame-level parse failure is corruption by definition
+        from . import hashplane
 
-        hasher = SHA256()
-        with open(path, "rb") as f:
-            while True:
-                chunk = f.read(1 << 20)
-                if not chunk:
-                    break
-                hasher.add(chunk)
-        return "ok" if hasher.finish() == h else "corrupt"
+        try:
+            got, _count = hashplane.hash_file(path, config=self.app.config)
+        except (ValueError, OSError):
+            return "corrupt"
+        return "ok" if got == h else "corrupt"
 
     def verify_bucket_files(self, *states) -> dict:
         """Every hash the given HistoryArchiveState(s) reference,

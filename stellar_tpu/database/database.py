@@ -1,9 +1,18 @@
 """SQL hot-state store (reference: src/database/Database.{h,cpp} over SOCI).
 
-sqlite3-backed (the reference's default is ``sqlite3://:memory:`` too;
-postgres is out of scope in this environment).  Provides:
+sqlite3-backed by default (the reference's default is
+``sqlite3://:memory:`` too), with a gated live postgres path: a
+``postgresql://`` connection string connects through whichever DB-API
+driver the host environment already has (psycopg / psycopg2 / pg8000 —
+nothing is installed for it) wrapped in a thin adapter that restores the
+sqlite3 connection surface the hot paths use (``execute`` returning a
+cursor, ``executemany``, ``total_changes``).  ``STELLAR_TPU_PG_DSN``
+substitutes for the sentinel strings ``postgresql://`` /
+``postgresql://env`` so test/config plumbing can opt in from the
+environment.  Provides:
 
-- connection-string parsing ("sqlite3://:memory:" | "sqlite3://<path>")
+- connection-string parsing ("sqlite3://:memory:" | "sqlite3://<path>"
+  | "postgresql://<dsn>")
 - nested transactions via a SAVEPOINT stack — the reference nests a SQL
   savepoint per transaction-apply inside the ledger-close transaction
   (TransactionFrame.cpp:439-495)
@@ -14,13 +23,14 @@ postgres is out of scope in this environment).  Provides:
 
 from __future__ import annotations
 
+import os
 import sqlite3
 import time
 from contextlib import contextmanager
 from typing import Any, Iterable, List, Optional, Tuple
 
 from ..util import fs
-from .dialect import dialect_for
+from .dialect import dialect_for, load_pg_driver
 
 SCHEMA_VERSION = 1
 
@@ -44,6 +54,88 @@ class UnrollbackableWrite(RuntimeError):
     DB state is unknown (LedgerManager._apply_transactions re-raises)."""
 
 
+class PgConnection:
+    """sqlite3-shaped facade over a postgres DB-API connection.
+
+    The hot paths were written against sqlite3's surface —
+    ``conn.execute(sql, params)`` returning a cursor, ``executemany``,
+    a monotonic ``total_changes`` — so the postgres drivers (which all
+    require an explicit cursor and have no change counter) are adapted
+    here rather than forked into every call site.  The connection is put
+    in driver autocommit so BEGIN/COMMIT/SAVEPOINT flow through
+    ``execute`` as explicit statements, exactly like sqlite with
+    ``isolation_level=None``.
+
+    ``total_changes`` counts successful DML rowcounts.  That is weaker
+    than sqlite's statement-ABORT semantics — which is precisely why
+    ``PostgresDialect.statement_abort_credits_total_changes`` is False
+    and ``Database.execute`` materializes real savepoints before any
+    direct write inside a buffered scope on this backend; the counter
+    here only needs to catch writes, never to credit back-outs."""
+
+    _DML = ("INSERT", "UPDATE", "DELETE")
+
+    def __init__(self, raw, driver_name: str):
+        self._raw = raw
+        self.driver_name = driver_name
+        self.total_changes = 0
+
+    def _count(self, sql: str, cur) -> None:
+        if sql.lstrip()[:6].upper() in self._DML and cur.rowcount > 0:
+            self.total_changes += cur.rowcount
+
+    def execute(self, sql: str, params: Iterable = ()):
+        cur = self._raw.cursor()
+        params = tuple(params)
+        if params:
+            cur.execute(sql, params)
+        else:
+            cur.execute(sql)
+        self._count(sql, cur)
+        return cur
+
+    def executemany(self, sql: str, rows):
+        cur = self._raw.cursor()
+        cur.executemany(sql, list(rows))
+        self._count(sql, cur)
+        return cur
+
+    def close(self) -> None:
+        self._raw.close()
+
+
+def connect_postgres(dsn: str) -> PgConnection:
+    """Connect to postgres through whichever driver the environment
+    already has (psycopg → psycopg2 → pg8000); refuses with a clear
+    error when none is importable — NOTHING is installed for this."""
+    loaded = load_pg_driver()
+    if loaded is None:
+        raise RuntimeError(
+            "postgresql connection requested but no driver is importable"
+            " (tried psycopg, psycopg2, pg8000) — install one in the host"
+            " environment or point DATABASE back at sqlite3://"
+        )
+    mod, name = loaded
+    if name == "psycopg":
+        raw = mod.connect(dsn, autocommit=True)
+    elif name == "psycopg2":
+        raw = mod.connect(dsn)
+        raw.autocommit = True
+    else:  # pg8000.dbapi takes keywords, not a DSN URI
+        from urllib.parse import urlsplit
+
+        u = urlsplit(dsn)
+        raw = mod.connect(
+            user=u.username or "postgres",
+            password=u.password,
+            host=u.hostname or "localhost",
+            port=u.port or 5432,
+            database=(u.path or "/").lstrip("/") or "postgres",
+        )
+        raw.autocommit = True
+    return PgConnection(raw, name)
+
+
 class Database:
     def __init__(self, connection_string: str = "sqlite3://:memory:", metrics=None):
         self.connection_string = connection_string
@@ -55,11 +147,19 @@ class Database:
         self._sql_translate = (
             self.dialect.translate if self.dialect.placeholder != "?" else None
         )
-        path = self._parse(connection_string)
-        self._conn = sqlite3.connect(path, isolation_level=None)
-        self._conn.execute("PRAGMA journal_mode=MEMORY" if path == ":memory:"
-                           else "PRAGMA journal_mode=WAL")
-        self._conn.execute("PRAGMA synchronous=OFF")
+        if self.dialect.name == "postgresql":
+            # live server path, gated on an importable driver.  The
+            # sentinel forms "postgresql://" / "postgresql://env" pull
+            # the DSN from STELLAR_TPU_PG_DSN so configs can opt in
+            # without embedding credentials.
+            self._conn = connect_postgres(self._pg_dsn(connection_string))
+        else:
+            path = self._parse(connection_string)
+            self._conn = sqlite3.connect(path, isolation_level=None)
+            self._conn.execute(
+                "PRAGMA journal_mode=MEMORY" if path == ":memory:"
+                else "PRAGMA journal_mode=WAL")
+            self._conn.execute("PRAGMA synchronous=OFF")
         self._metrics = metrics
         self._tx_depth = 0
         self._sp_counter = 0
@@ -73,6 +173,17 @@ class Database:
         if cs.startswith("sqlite3://"):
             return cs[len("sqlite3://") :]
         raise ValueError(f"unsupported DATABASE connection string: {cs}")
+
+    @staticmethod
+    def _pg_dsn(cs: str) -> str:
+        if cs in ("postgresql://", "postgresql://env"):
+            dsn = os.environ.get("STELLAR_TPU_PG_DSN")
+            if not dsn:
+                raise ValueError(
+                    f"{cs!r} requires STELLAR_TPU_PG_DSN in the environment"
+                )
+            return dsn
+        return cs
 
     def _unmaterialized_scopes(self) -> bool:
         return any(slot[0] is None for slot in self._lazy_sps)

@@ -15,25 +15,49 @@ statement flow through a ``Dialect`` object (``Database.dialect``):
   ``statement_abort_credits_total_changes`` says the backend supports
   it, and falls back to materializing real savepoints otherwise.
 
-``column_type`` is a recorded mapping, not yet a routed one — schema
-DDL is authored inline in the frame classes in generic type names that
-sqlite accepts as-is; a postgres backend additionally rewrites the
-CREATE TABLE corpus through ``column_type`` and the INSERT OR REPLACE
-batches into ON CONFLICT form (listed on ``PostgresDialect`` so the
-first live-postgres PR starts from a checklist, not archaeology).
-``CacheIsConsistentWithDatabase`` (stellar_tpu/invariant/) gets a
-second backend to run against the day one lands.
+``rewrite`` is the statement-rewrite pass that makes the seam LIVE: a
+non-sqlite backend sees every statement before placeholder translation,
+so ``PostgresDialect`` routes the CREATE TABLE corpus through
+``column_type`` and rewrites the four ``INSERT OR REPLACE`` upsert
+batches (accounts / trustlines / offers / publishqueue — the store
+buffer's flush surface) into ``ON CONFLICT (pk) DO UPDATE`` form.  An
+upsert against a table the conflict-target map does not know is refused
+loudly — a silently-dropped rewrite would corrupt the flush.
+``CacheIsConsistentWithDatabase`` (stellar_tpu/invariant/) is the live
+oracle for the whole pipeline: it runs against postgres whenever
+``STELLAR_TPU_PG_DSN`` names a reachable server.
 
-``SqliteDialect`` is the shipped default; ``PostgresDialect`` captures
-the mapping decisions up front and is exercised by server-gated tests
-(tests/test_dialect.py: skipped unless ``STELLAR_TPU_PG_DSN`` points at
-a live server and a driver is importable — nothing is pip-installed for
-it).
+``SqliteDialect`` is the shipped default; ``PostgresDialect`` is
+exercised serverless for every mapping/rewrite decision plus
+server-gated (tests/test_dialect.py: skipped unless
+``STELLAR_TPU_PG_DSN`` points at a live server and a driver is
+importable — nothing is pip-installed for it).
 """
 
 from __future__ import annotations
 
-from typing import Dict
+import re
+from typing import Dict, Optional, Tuple
+
+
+#: driver candidates in preference order — psycopg (3) first, then the
+#: legacy psycopg2, then the pure-python pg8000.  NOTHING is installed
+#: for this: whichever the host environment already has wins.
+PG_DRIVER_CANDIDATES = ("psycopg", "psycopg2", "pg8000.dbapi")
+
+
+def load_pg_driver() -> Optional[Tuple[object, str]]:
+    """Import the first available postgres DB-API driver, or None when
+    the environment has none (this container ships none — the connect
+    path then refuses with a clear error instead of an ImportError)."""
+    import importlib
+
+    for name in PG_DRIVER_CANDIDATES:
+        try:
+            return importlib.import_module(name), name
+        except ImportError:
+            continue
+    return None
 
 
 class Dialect:
@@ -64,6 +88,12 @@ class Dialect:
         return f"ROLLBACK TO SAVEPOINT {name}"
 
     # -- statements ---------------------------------------------------------
+    def rewrite(self, sql: str) -> str:
+        """Backend statement rewrite (DDL types, upsert syntax) applied
+        BEFORE placeholder translation.  Identity on sqlite — the schema
+        corpus is authored in the dialect it accepts as-is."""
+        return sql
+
     def translate(self, sql: str) -> str:
         """Rewrite ``?`` placeholders into this backend's style (string
         literals in our schema/statement set never contain ``?``, so a
@@ -72,9 +102,12 @@ class Dialect:
         ``format``-paramstyle backends additionally require literal ``%``
         doubled to ``%%`` (a future ``LIKE '%x%'`` would otherwise raise
         in the driver); double BEFORE substituting so the injected ``%s``
-        placeholders stay intact."""
+        placeholders stay intact.  ``rewrite`` runs first, on the qmark
+        form — the one hook ``Database`` routes therefore carries the
+        whole backend statement pipeline."""
         if self.placeholder == "?":
             return sql
+        sql = self.rewrite(sql)
         if self.paramstyle in ("format", "pyformat"):
             sql = sql.replace("%", "%%")
         return sql.replace("?", self.placeholder)
@@ -93,11 +126,14 @@ class SqliteDialect(Dialect):
 
 
 class PostgresDialect(Dialect):
-    """The postgres half of the seam: the mapping decisions, written down
-    and unit-tested, without a live server in the loop.  INSERT OR
-    REPLACE / executemany batching (storebuffer flush) would additionally
-    need ON CONFLICT rewrites — recorded here so the first live-postgres
-    PR starts from a checklist, not archaeology."""
+    """The postgres half of the seam, live: ``rewrite`` routes the CREATE
+    TABLE corpus through ``type_map`` and turns the INSERT OR REPLACE
+    upsert batches (the store buffer's flush surface) into
+    ``ON CONFLICT (pk) DO UPDATE SET col=EXCLUDED.col`` form using the
+    conflict-target registry below.  The registry is authoritative: an
+    upsert against an unregistered table raises instead of passing
+    through — postgres would reject the sqlite spelling anyway, and a
+    half-rewritten flush must never limp into the server."""
 
     name = "postgresql"
     paramstyle = "format"
@@ -115,6 +151,51 @@ class PostgresDialect(Dialect):
         "VARCHAR(12)": "VARCHAR(12)",
         "BLOB": "BYTEA",
     }
+    #: table -> primary-key columns, mirroring the CREATE TABLE corpus.
+    #: sqlite's INSERT OR REPLACE keys on the PK implicitly; postgres
+    #: needs it named in the ON CONFLICT target.
+    upsert_conflict_targets = {
+        "accounts": ("accountid",),
+        "trustlines": ("accountid", "issuer", "assetcode"),
+        "offers": ("offerid",),
+        "publishqueue": ("ledger",),
+    }
+
+    _UPSERT_RE = re.compile(
+        r"^\s*INSERT\s+OR\s+REPLACE\s+INTO\s+(\w+)\s*\(([^)]*)\)(.*)$",
+        re.IGNORECASE | re.DOTALL,
+    )
+    _CREATE_RE = re.compile(r"^\s*CREATE\s+TABLE\b", re.IGNORECASE)
+
+    def rewrite(self, sql: str) -> str:
+        m = self._UPSERT_RE.match(sql)
+        if m:
+            table, collist, rest = m.group(1), m.group(2), m.group(3)
+            target = self.upsert_conflict_targets.get(table.lower())
+            if target is None:
+                raise ValueError(
+                    f"INSERT OR REPLACE against {table!r} has no registered"
+                    " conflict target — add it to"
+                    " PostgresDialect.upsert_conflict_targets"
+                )
+            cols = [c.strip() for c in collist.split(",")]
+            updates = ", ".join(
+                f"{c}=EXCLUDED.{c}" for c in cols if c.lower() not in target
+            )
+            return (
+                f"INSERT INTO {table} ({', '.join(cols)}){rest.rstrip()}"
+                f" ON CONFLICT ({', '.join(target)}) DO UPDATE SET {updates}"
+            )
+        if self._CREATE_RE.match(sql):
+            # the DDL corpus spells types in the generic names type_map
+            # keys on; longest-first so DOUBLE PRECISION wins over INT
+            for generic in sorted(self.type_map, key=len, reverse=True):
+                spelled = self.type_map[generic]
+                if spelled != generic:
+                    sql = re.sub(
+                        rf"\b{re.escape(generic)}\b", spelled, sql
+                    )
+        return sql
 
 
 _DIALECTS = {

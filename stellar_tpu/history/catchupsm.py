@@ -326,17 +326,24 @@ class CatchupStateMachine:
 
     def _adopt_bucket_files(self, files: List[FileTransferInfo]) -> None:
         """Verify each fetched bucket file against its content hash and
-        adopt it into the bucket dir."""
-        from ..crypto import SHA256
+        adopt it into the bucket dir.  Archive names carry the v2
+        state-plane hash (bucket/hashplane.py), so verification is the
+        same batched per-record re-hash the boot self-check runs — a
+        malformed frame stream fails verification like any wrong hash."""
+        from ..bucket import hashplane
 
         bm = self.app.bucket_manager
         for fi in files:
             if fi.category != CAT_BUCKET:
                 continue
-            h = SHA256()
-            with open(fi.local_path, "rb") as f:
-                h.add(f.read())
-            got = h.finish()
+            try:
+                got, _count = hashplane.hash_file(
+                    fi.local_path, config=self.app.config
+                )
+            except ValueError:
+                raise RuntimeError(
+                    f"bucket {fi.base_name} has malformed frames"
+                )
             want = bytes.fromhex(fi.base_name[7:-4])
             if got != want:
                 raise RuntimeError(f"bucket {fi.base_name} hash mismatch")

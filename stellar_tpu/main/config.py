@@ -198,6 +198,22 @@ class Config:
         # verdicts are bit-exact either way (tests/test_sha512_device).
         # Only meaningful with SIGNATURE_BACKEND = "tpu".
         self.DEVICE_HASH = False
+        # device-resident STATE-plane hashing (ISSUE r22, ops/sha256.py +
+        # bucket/hashplane.py): the per-record bucket digests — fresh
+        # batches, level-spill merges, selfcheck's full-tree re-hash —
+        # run on the batched multi-block SHA-256 kernel instead of the
+        # pooled C host stage.  Off by default like DEVICE_HASH: an
+        # opt-in certified by the paired bucket_hash bench legs and the
+        # relay bucket_hash_r22 A/B gate; hashes are bit-exact across
+        # device/native/hashlib backends (tests/test_hashplane.py).
+        self.DEVICE_BUCKET_HASH = False
+        # level-spill merges run on the dedicated background workers
+        # (bucket/mergeworker.py) so the close boundary that commits a
+        # spill finds the merge already done.  False = merge
+        # synchronously inside prepare() — the bit-exact differential
+        # baseline (hashes cannot depend on where the deterministic
+        # merge ran) and a single-step debugging crutch.
+        self.BACKGROUND_BUCKET_MERGE = True
         # TPU-native addition: which signature scheme serves SCP envelope
         # verification for the quorum set this node faces
         # (crypto/aggregate/).  "ed25519" = the reference per-envelope
@@ -424,6 +440,12 @@ class Config:
             raise ValueError(
                 f"DEVICE_HASH must be a boolean (or 0/1), got {dh!r}"
             )
+        for knob in ("DEVICE_BUCKET_HASH", "BACKGROUND_BUCKET_MERGE"):
+            v = getattr(self, knob)
+            if not (isinstance(v, bool) or v in (0, 1)):
+                raise ValueError(
+                    f"{knob} must be a boolean (or 0/1), got {v!r}"
+                )
         if not (
             isinstance(self.OVERLAY_SENDQ_BYTES, int)
             and not isinstance(self.OVERLAY_SENDQ_BYTES, bool)

@@ -276,6 +276,7 @@ def _check_header_chain(app, result: dict, repair: bool = True):
 
 
 def _check_bucket_files(app, result: dict, header, repair: bool = True) -> None:
+    from ..bucket import hashplane
     from ..history import publish as publish_queue
     from ..history.archive import HistoryArchiveState
     from .persistentstate import K_HISTORY_ARCHIVE_STATE
@@ -295,7 +296,19 @@ def _check_bucket_files(app, result: dict, header, repair: bool = True) -> None:
             states.append(HistoryArchiveState.from_json(state_json))
     except Exception:
         pass  # torn rows were dropped by _check_publish_queue
+    # the full-tree re-hash rides the hash plane (bucket/hashplane.py);
+    # the before/after stats delta is this sweep's throughput — the boot
+    # report's backend-regression canary (a node silently falling back
+    # from device/native to hashlib shows up here first)
+    hash_before = hashplane.stats.snapshot()
     verdicts = bm.verify_bucket_files(*states)
+    hash_after = hashplane.stats.snapshot()
+    result["rehash_mb_per_sec"] = hashplane._Stats.rate_mb_per_sec(
+        hash_before, hash_after
+    )
+    result["rehash_backend"] = (
+        hash_after["backend"] or hashplane.get_backend(app.config).name
+    )
     result["buckets_checked"] = sum(len(v) for v in verdicts.values())
     for h in verdicts["corrupt"]:
         if not repair:

@@ -156,6 +156,21 @@ def _load():
         ]
         lib.sha256_file.restype = ctypes.c_int
         lib.sha256_file.argtypes = [ctypes.c_char_p, ctypes.c_char * 32]
+        # v2 bucket-hash symbols (ISSUE r22) are OPTIONAL: a stale
+        # prebuilt .so (source-stripped deployment, _needs_build says
+        # use-as-is) simply lacks them — the wrappers below return None
+        # and the callers fall back to the Python v2 paths, never to a
+        # silently-wrong v1 hash (pinned by tests/test_hashplane.py)
+        if hasattr(lib, "bucket_merge_v2"):
+            lib.bucket_merge_v2.restype = ctypes.c_int
+            lib.bucket_merge_v2.argtypes = lib.bucket_merge.argtypes
+        if hasattr(lib, "bucket_hash_v2_file"):
+            lib.bucket_hash_v2_file.restype = ctypes.c_int
+            lib.bucket_hash_v2_file.argtypes = [
+                ctypes.c_char_p,
+                ctypes.c_char * 32,
+                ctypes.POINTER(ctypes.c_longlong),
+            ]
         _lib = lib
         return _lib
 
@@ -209,6 +224,60 @@ def sha256_file(path: str) -> Optional[bytes]:
     if lib.sha256_file(path.encode(), out) != 0:
         return None
     return bytes(out)
+
+
+def merge_files_v2(
+    old_path: str,
+    new_path: str,
+    shadow_paths: Sequence[str],
+    keep_dead: bool,
+    out_path: str,
+) -> Optional[Tuple[bytes, int]]:
+    """merge_files with the v2 per-record-digest bucket hash (ISSUE r22,
+    bucket/hashplane.py).  Same record stream as merge_files; only the
+    content hash differs.  None when the engine (or the v2 symbol, on a
+    stale prebuilt .so) is unavailable — the caller's Python fallback
+    produces the identical v2 hash."""
+    lib = _load()
+    if (
+        lib is None
+        or not hasattr(lib, "bucket_merge_v2")
+        or len(shadow_paths) > 32
+    ):
+        return None
+    shadows = (ctypes.c_char_p * max(1, len(shadow_paths)))()
+    for i, p in enumerate(shadow_paths):
+        shadows[i] = p.encode()
+    out_hash = (ctypes.c_char * 32)()
+    out_count = ctypes.c_longlong(0)
+    rc = lib.bucket_merge_v2(
+        old_path.encode(),
+        new_path.encode(),
+        shadows,
+        len(shadow_paths),
+        1 if keep_dead else 0,
+        out_path.encode(),
+        out_hash,
+        ctypes.byref(out_count),
+    )
+    if rc != 0:
+        return None
+    return bytes(out_hash), int(out_count.value)
+
+
+def bucket_hash_v2_file(path: str) -> Optional[Tuple[bytes, int]]:
+    """(v2 content hash, record count) of an existing bucket file, or
+    None when unavailable (caller falls back to the Python walk) — a
+    malformed/truncated frame also returns None (treated as corrupt by
+    the verify layer, which re-checks in Python for the verdict)."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "bucket_hash_v2_file"):
+        return None
+    out = (ctypes.c_char * 32)()
+    count = ctypes.c_longlong(0)
+    if lib.bucket_hash_v2_file(path.encode(), out, ctypes.byref(count)) != 0:
+        return None
+    return bytes(out), int(count.value)
 
 
 # -- cxdrpack: the C XDR pack interpreter (CPython extension) ---------------

@@ -280,9 +280,20 @@ typedef struct {
     unsigned char pend_key[96]; /* max identity: trustline 36+52 = 88 */
     size_t pend_keylen;
     int pend_have;
+    int v2; /* per-record-digest bucket hash (see emit) */
 } writer;
 
-/* write one framed record + hash it */
+/* write one framed record + hash it.
+ *
+ * v1 hash: incremental SHA-256 over the raw frame stream as written.
+ * v2 hash (ISSUE r22, bucket/hashplane.py): SHA-256 over the CONCAT OF
+ * PER-RECORD DIGESTS, each digest = SHA-256(4-byte header ‖ body) of one
+ * full frame.  The per-record digests are what the batched device/pooled
+ * host kernels compute in parallel; this sequential combine touches 32
+ * bytes per record (~3% of the stream), so the hash cost parallelizes.
+ * Hashes are framework-local (bucket.py header note), so the scheme is
+ * free to differ from the reference's stream hash — all producers and
+ * verifiers changed together. */
 static int emit(writer *w, const unsigned char *body, size_t len) {
     unsigned char hdr[4];
     uint32_t framed = (uint32_t)len | 0x80000000u;
@@ -292,8 +303,18 @@ static int emit(writer *w, const unsigned char *body, size_t len) {
     hdr[3] = (unsigned char)framed;
     if (fwrite(hdr, 1, 4, w->f) != 4) return -1;
     if (fwrite(body, 1, len, w->f) != len) return -1;
-    sha256_update(&w->sha, hdr, 4);
-    sha256_update(&w->sha, body, len);
+    if (w->v2) {
+        sha256_ctx rec;
+        unsigned char digest[32];
+        sha256_init(&rec);
+        sha256_update(&rec, hdr, 4);
+        sha256_update(&rec, body, len);
+        sha256_final(&rec, digest);
+        sha256_update(&w->sha, digest, 32);
+    } else {
+        sha256_update(&w->sha, hdr, 4);
+        sha256_update(&w->sha, body, len);
+    }
     w->count++;
     return 0;
 }
@@ -352,14 +373,16 @@ static int put(writer *w, const stream *s) {
     return buffer_rec(w, s);
 }
 
-int bucket_merge(const char *old_path, const char *new_path,
-                 const char **shadow_paths, int n_shadows, int keep_dead,
-                 const char *out_path, unsigned char out_hash[32],
-                 long long *out_count) {
+static int merge_impl(const char *old_path, const char *new_path,
+                      const char **shadow_paths, int n_shadows,
+                      int keep_dead, const char *out_path,
+                      unsigned char out_hash[32], long long *out_count,
+                      int v2) {
     stream so, sn;
     writer w;
     int i, rc = -1;
     memset(&w, 0, sizeof w);
+    w.v2 = v2;
     if (n_shadows > MAX_SHADOWS) return -1;
     if (stream_open(&so, old_path) != 0) return -1;
     if (stream_open(&sn, new_path) != 0) {
@@ -415,7 +438,27 @@ done:
     return rc;
 }
 
-/* streaming SHA-256 of a whole file (bucket adoption verification) */
+int bucket_merge(const char *old_path, const char *new_path,
+                 const char **shadow_paths, int n_shadows, int keep_dead,
+                 const char *out_path, unsigned char out_hash[32],
+                 long long *out_count) {
+    return merge_impl(old_path, new_path, shadow_paths, n_shadows,
+                      keep_dead, out_path, out_hash, out_count, 0);
+}
+
+/* v2 merge: identical record stream, per-record-digest bucket hash (the
+ * symbol is NEW so a stale prebuilt .so simply lacks it and the loader
+ * falls back to the Python merge — never a silent v1/v2 hash mismatch) */
+int bucket_merge_v2(const char *old_path, const char *new_path,
+                    const char **shadow_paths, int n_shadows, int keep_dead,
+                    const char *out_path, unsigned char out_hash[32],
+                    long long *out_count) {
+    return merge_impl(old_path, new_path, shadow_paths, n_shadows,
+                      keep_dead, out_path, out_hash, out_count, 1);
+}
+
+/* streaming SHA-256 of a whole file (raw byte-stream hash; kept for the
+ * pre-v2 differential pins in tests/test_native_merge.py) */
 int sha256_file(const char *path, unsigned char out[32]) {
     unsigned char buf[1 << 16];
     sha256_ctx c;
@@ -427,4 +470,55 @@ int sha256_file(const char *path, unsigned char out[32]) {
     fclose(f);
     sha256_final(&c, out);
     return 0;
+}
+
+/* v2 re-hash of an existing bucket file: walk the RFC 5531 frames
+ * (4-byte big-endian header, continuation bit set, 64 MiB body cap —
+ * the exact bounds util/xdrstream.py and stream_next enforce), digest
+ * each full frame, combine the digests.  Returns -1 on open failure or
+ * any malformed/truncated frame (the caller treats that as corrupt). */
+int bucket_hash_v2_file(const char *path, unsigned char out[32],
+                        long long *out_count) {
+    unsigned char hdr[4];
+    unsigned char *body = NULL;
+    size_t cap = 0;
+    long long count = 0;
+    sha256_ctx comb;
+    FILE *f = fopen(path, "rb");
+    if (!f) return -1;
+    sha256_init(&comb);
+    for (;;) {
+        size_t got = fread(hdr, 1, 4, f);
+        uint32_t len;
+        sha256_ctx rec;
+        unsigned char digest[32];
+        if (got == 0) break; /* clean EOF at a frame boundary */
+        if (got != 4 || !(hdr[0] & 0x80)) goto bad;
+        len = (((uint32_t)hdr[0] << 24) | ((uint32_t)hdr[1] << 16) |
+               ((uint32_t)hdr[2] << 8) | hdr[3]) &
+              0x7fffffffu;
+        if (len > (64u << 20)) goto bad;
+        if (len > cap) {
+            unsigned char *nb = (unsigned char *)realloc(body, len);
+            if (!nb) goto bad;
+            body = nb;
+            cap = len;
+        }
+        if (len && fread(body, 1, len, f) != len) goto bad;
+        sha256_init(&rec);
+        sha256_update(&rec, hdr, 4);
+        sha256_update(&rec, body, len);
+        sha256_final(&rec, digest);
+        sha256_update(&comb, digest, 32);
+        count++;
+    }
+    free(body);
+    fclose(f);
+    sha256_final(&comb, out);
+    *out_count = count;
+    return 0;
+bad:
+    free(body);
+    fclose(f);
+    return -1;
 }

@@ -661,6 +661,181 @@ run_verify_tiles(void *arg)
 }
 
 /* ------------------------------------------------------------------ */
+/* SHA-256 (FIPS 180-4) + the bucket-hash batch tiles (ISSUE r22)      */
+/*                                                                     */
+/* The state plane's per-record bucket digests (bucket/hashplane.py)   */
+/* ride the SAME worker pool as the verify staging: each tile digests  */
+/* a run of frames with the GIL released, so a million-entry bucket    */
+/* re-hash fans across every core with one Python call.                */
+/* ------------------------------------------------------------------ */
+
+typedef struct {
+    uint32_t h[8];
+    uint64_t len;
+    unsigned char buf[64];
+    size_t buflen;
+} sha256_ctx;
+
+static const uint32_t K256[64] = {
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+
+#define ROR32(x, n) (((x) >> (n)) | ((x) << (32 - (n))))
+
+static void
+sha256_init(sha256_ctx *c)
+{
+    static const uint32_t h0[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372,
+                                   0xa54ff53a, 0x510e527f, 0x9b05688c,
+                                   0x1f83d9ab, 0x5be0cd19};
+    memcpy(c->h, h0, sizeof h0);
+    c->len = 0;
+    c->buflen = 0;
+}
+
+static void
+sha256_block(sha256_ctx *c, const unsigned char *p)
+{
+    uint32_t w[64], a, b, d, e, f, g, h, t1, t2, s0, s1, ch, maj, hh;
+    int i;
+    for (i = 0; i < 16; i++)
+        w[i] = ((uint32_t)p[4 * i] << 24) | ((uint32_t)p[4 * i + 1] << 16) |
+               ((uint32_t)p[4 * i + 2] << 8) | p[4 * i + 3];
+    for (i = 16; i < 64; i++) {
+        s0 = ROR32(w[i - 15], 7) ^ ROR32(w[i - 15], 18) ^ (w[i - 15] >> 3);
+        s1 = ROR32(w[i - 2], 17) ^ ROR32(w[i - 2], 19) ^ (w[i - 2] >> 10);
+        w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+    }
+    a = c->h[0]; b = c->h[1]; hh = c->h[2]; d = c->h[3];
+    e = c->h[4]; f = c->h[5]; g = c->h[6]; h = c->h[7];
+    for (i = 0; i < 64; i++) {
+        s1 = ROR32(e, 6) ^ ROR32(e, 11) ^ ROR32(e, 25);
+        ch = (e & f) ^ (~e & g);
+        t1 = h + s1 + ch + K256[i] + w[i];
+        s0 = ROR32(a, 2) ^ ROR32(a, 13) ^ ROR32(a, 22);
+        maj = (a & b) ^ (a & hh) ^ (b & hh);
+        t2 = s0 + maj;
+        h = g; g = f; f = e; e = d + t1;
+        d = hh; hh = b; b = a; a = t1 + t2;
+    }
+    c->h[0] += a; c->h[1] += b; c->h[2] += hh; c->h[3] += d;
+    c->h[4] += e; c->h[5] += f; c->h[6] += g; c->h[7] += h;
+}
+
+static void
+sha256_update(sha256_ctx *c, const unsigned char *p, size_t n)
+{
+    c->len += n;
+    if (c->buflen) {
+        size_t take = 64 - c->buflen;
+        if (take > n) take = n;
+        memcpy(c->buf + c->buflen, p, take);
+        c->buflen += take;
+        p += take;
+        n -= take;
+        if (c->buflen == 64) {
+            sha256_block(c, c->buf);
+            c->buflen = 0;
+        }
+    }
+    while (n >= 64) {
+        sha256_block(c, p);
+        p += 64;
+        n -= 64;
+    }
+    if (n) {
+        memcpy(c->buf, p, n);
+        c->buflen = n;
+    }
+}
+
+static void
+sha256_final(sha256_ctx *c, unsigned char out[32])
+{
+    uint64_t bitlen = c->len * 8;
+    unsigned char pad = 0x80;
+    unsigned char z = 0;
+    unsigned char lenb[8];
+    int i;
+    sha256_update(c, &pad, 1);
+    while (c->buflen != 56) sha256_update(c, &z, 1);
+    for (i = 0; i < 8; i++)
+        lenb[i] = (unsigned char)(bitlen >> (56 - 8 * i));
+    sha256_update(c, lenb, 8);
+    for (i = 0; i < 8; i++) {
+        out[4 * i] = (unsigned char)(c->h[i] >> 24);
+        out[4 * i + 1] = (unsigned char)(c->h[i] >> 16);
+        out[4 * i + 2] = (unsigned char)(c->h[i] >> 8);
+        out[4 * i + 3] = (unsigned char)(c->h[i]);
+    }
+}
+
+/* items are (pointer, length) spans — either borrowed bytes objects
+ * (sha256_batch) or frame spans inside one pinned buffer
+ * (bucket_hash_frames); out is the n*32 digest array */
+typedef struct {
+    const uint8_t *p;
+    Py_ssize_t len;
+    PyObject *o; /* strong ref, NULL for in-buffer spans */
+} HSpan;
+
+typedef struct {
+    const HSpan *spans;
+    size_t n;
+    uint8_t *out;     /* n * 32, row i = digest of span i */
+    size_t next_tile; /* atomic work counter */
+} HJob;
+
+/* bucket frames average a few hundred bytes (~1 us/digest): big tiles
+ * keep the atomic counter cold, and fanout pays off quickly */
+#define HTILE 128
+#define HPAR_MIN 512
+
+static void
+run_hash_tiles(void *arg)
+{
+    HJob *j = arg;
+    size_t ntiles = (j->n + HTILE - 1) / HTILE, t;
+    while ((t = __atomic_fetch_add(&j->next_tile, 1, __ATOMIC_RELAXED)) <
+           ntiles) {
+        size_t lo = t * HTILE;
+        size_t hi = lo + HTILE;
+        size_t i;
+        if (hi > j->n)
+            hi = j->n;
+        for (i = lo; i < hi; i++) {
+            sha256_ctx c;
+            sha256_init(&c);
+            sha256_update(&c, j->spans[i].p, (size_t)j->spans[i].len);
+            sha256_final(&c, j->out + 32 * i);
+        }
+    }
+}
+
+static void
+run_hash_job(HJob *job, size_t n, int threads)
+{
+    if (threads == 1 || n < HPAR_MIN || hw_threads() < 2) {
+        run_hash_tiles(job);
+    } else if (pthread_mutex_trylock(&pool_busy) == 0) {
+        run_parallel(run_hash_tiles, job);
+        pthread_mutex_unlock(&pool_busy);
+    } else {
+        /* the pool is mid-job for another caller: run inline */
+        run_hash_tiles(job);
+    }
+}
+
+/* ------------------------------------------------------------------ */
 /* Python entry points                                                 */
 /* ------------------------------------------------------------------ */
 
@@ -992,6 +1167,175 @@ sighash_reduce512(PyObject *self, PyObject *args)
     return res;
 }
 
+/* sha256_batch(items, out, threads=0) -> None
+ *
+ * items     sequence of immutable bytes objects
+ * out       writable buffer >= len(items)*32: digest i lands at 32*i
+ * threads   0 = auto (pool when n >= 512 and >1 core), 1 = inline
+ *
+ * The per-item digest batch of the state-plane hash pipeline
+ * (bucket/hashplane.py): the whole pass runs with the GIL released,
+ * tile-fanned over the worker pool. */
+static PyObject *
+sighash_sha256_batch(PyObject *self, PyObject *args)
+{
+    PyObject *seq, *fast = NULL;
+    Py_buffer outb = {0};
+    int threads = 0;
+    HSpan *spans = NULL;
+    Py_ssize_t n = 0, j;
+    (void)self;
+
+    if (!PyArg_ParseTuple(args, "Ow*|i", &seq, &outb, &threads))
+        return NULL;
+    fast = PySequence_Fast(seq, "sha256_batch needs a sequence of bytes");
+    if (fast == NULL)
+        goto fail;
+    n = PySequence_Fast_GET_SIZE(fast);
+    if (outb.len < n * 32) {
+        PyErr_SetString(PyExc_ValueError, "out buffer too small (n*32)");
+        goto fail;
+    }
+    spans = PyMem_Malloc((n ? n : 1) * sizeof(HSpan));
+    if (spans == NULL) {
+        PyErr_NoMemory();
+        goto fail;
+    }
+    memset(spans, 0, (n ? n : 1) * sizeof(HSpan));
+    for (j = 0; j < n; j++) {
+        spans[j].o = borrow_bytes(PySequence_Fast_GET_ITEM(fast, j),
+                                  &spans[j].p, &spans[j].len);
+        if (!spans[j].o)
+            goto fail;
+    }
+
+    {
+        HJob job;
+        job.spans = spans;
+        job.n = (size_t)n;
+        job.out = (uint8_t *)outb.buf;
+        job.next_tile = 0;
+        Py_BEGIN_ALLOW_THREADS
+        run_hash_job(&job, (size_t)n, threads);
+        Py_END_ALLOW_THREADS
+    }
+
+    for (j = 0; j < n; j++)
+        Py_DECREF(spans[j].o);
+    PyMem_Free(spans);
+    Py_DECREF(fast);
+    PyBuffer_Release(&outb);
+    Py_RETURN_NONE;
+
+fail:
+    if (spans != NULL)
+        for (j = 0; j < n; j++)
+            Py_XDECREF(spans[j].o);
+    PyMem_Free(spans);
+    Py_XDECREF(fast);
+    if (outb.obj)
+        PyBuffer_Release(&outb);
+    return NULL;
+}
+
+/* bucket_hash_frames(buf, threads=0) -> (digest32, count)
+ *
+ * The one-call host path of the v2 bucket hash: walk the RFC 5531
+ * frames of a whole bucket buffer (4-byte big-endian header with the
+ * continuation bit, 64 MiB body cap — util/xdrstream.py's bounds),
+ * digest every full frame in parallel over the worker pool, then
+ * combine the digests in frame order.  Raises ValueError on any
+ * malformed or truncated frame.  buf accepts anything read-only
+ * buffer-shaped (bytes, memoryview, mmap) and stays pinned for the
+ * GIL-released pass. */
+static PyObject *
+sighash_bucket_hash_frames(PyObject *self, PyObject *args)
+{
+    Py_buffer buf = {0};
+    int threads = 0;
+    HSpan *spans = NULL;
+    uint8_t *digests = NULL;
+    size_t n = 0, cap = 0, off = 0, i;
+    const uint8_t *p;
+    size_t len;
+    unsigned char out[32];
+    int bad = 0;
+    PyObject *res;
+    (void)self;
+
+    if (!PyArg_ParseTuple(args, "y*|i", &buf, &threads))
+        return NULL;
+    p = (const uint8_t *)buf.buf;
+    len = (size_t)buf.len;
+
+    Py_BEGIN_ALLOW_THREADS
+    /* pass 1: frame walk (sequential, ~ns per frame) */
+    while (off < len) {
+        uint32_t flen;
+        if (off + 4 > len || !(p[off] & 0x80)) {
+            bad = 1;
+            break;
+        }
+        flen = (((uint32_t)p[off] << 24) | ((uint32_t)p[off + 1] << 16) |
+                ((uint32_t)p[off + 2] << 8) | p[off + 3]) &
+               0x7fffffffu;
+        if (flen > (64u << 20) || off + 4 + flen > len) {
+            bad = 1;
+            break;
+        }
+        if (n == cap) {
+            size_t ncap = cap ? cap * 2 : 1024;
+            HSpan *ns = (HSpan *)realloc(spans, ncap * sizeof(HSpan));
+            if (!ns) {
+                bad = 2;
+                break;
+            }
+            spans = ns;
+            cap = ncap;
+        }
+        spans[n].p = p + off;
+        spans[n].len = 4 + flen; /* <= 64 MB + 4: fits the signed field */
+        spans[n].o = NULL;
+        n++;
+        off += 4 + flen;
+    }
+    if (!bad && n) {
+        digests = (uint8_t *)malloc(n * 32);
+        if (!digests)
+            bad = 2;
+    }
+    if (!bad) {
+        /* pass 2: parallel per-frame digests, pass 3: ordered combine */
+        sha256_ctx comb;
+        HJob job;
+        job.spans = spans;
+        job.n = n;
+        job.out = digests;
+        job.next_tile = 0;
+        if (n)
+            run_hash_job(&job, n, threads);
+        sha256_init(&comb);
+        for (i = 0; i < n; i++)
+            sha256_update(&comb, digests + 32 * i, 32);
+        sha256_final(&comb, out);
+    }
+    Py_END_ALLOW_THREADS
+
+    free(spans);
+    free(digests);
+    PyBuffer_Release(&buf);
+    if (bad == 2)
+        return PyErr_NoMemory();
+    if (bad) {
+        PyErr_SetString(PyExc_ValueError,
+                        "malformed or truncated bucket frame");
+        return NULL;
+    }
+    res = Py_BuildValue("(y#n)", (const char *)out, (Py_ssize_t)32,
+                        (Py_ssize_t)n);
+    return res;
+}
+
 static PyMethodDef methods[] = {
     {"stage", sighash_stage, METH_VARARGS,
      "stage(items, start, count, out, ok, blacklist, threads=0) -> "
@@ -1004,6 +1348,14 @@ static PyMethodDef methods[] = {
      "sodium_verify(fn_addr, items, ok, threads=0): batch libsodium"
      " strict verify over the worker pool, GIL released; verdicts land"
      " in the ok buffer"},
+    {"sha256_batch", sighash_sha256_batch, METH_VARARGS,
+     "sha256_batch(items, out, threads=0): batch SHA-256 of a bytes"
+     " sequence over the worker pool, GIL released; digest i lands at"
+     " out[32*i:32*i+32]"},
+    {"bucket_hash_frames", sighash_bucket_hash_frames, METH_VARARGS,
+     "bucket_hash_frames(buf, threads=0) -> (digest32, count): v2"
+     " bucket hash of a framed record buffer — parallel per-frame"
+     " digests + ordered combine (bucket/hashplane.py host path)"},
     {"_sha512_rax", sighash_sha512_rax, METH_VARARGS,
      "_sha512_rax(r32, a32, msg) -> sha512(r||a||msg) digest (test hook)"},
     {"_reduce512", sighash_reduce512, METH_VARARGS,

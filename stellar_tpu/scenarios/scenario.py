@@ -83,6 +83,12 @@ class ScenarioSpec:
     load_rate: int = 40
     load_backlog_ledgers: int = 0
     load_target: int = 0
+    # per-node DESIRED_MAX_TX_PER_LEDGER override — the backlog shapes
+    # need a cap SMALLER than the queued load so consecutive closes each
+    # propose a full set (one giant set swallowing the whole load makes
+    # the >1-close pipelined-backlog assertion hinge on which single
+    # slot the burst lands in).  None keeps the Config default
+    max_tx_per_ledger: Optional[int] = None
     # overlay survival plane (overlay/sendqueue.py) — None keeps the
     # Config default on every node; 0 for sendq_bytes turns the plane
     # off (the knob-off transparency leg)
@@ -208,6 +214,8 @@ class Scenario:
             cfg.OVERLAY_SENDQ_FLOOD_MSGS = self.spec.sendq_flood_msgs
         if self.spec.straggler_stall_ms is not None:
             cfg.STRAGGLER_STALL_MS = self.spec.straggler_stall_ms
+        if self.spec.max_tx_per_ledger is not None:
+            cfg.DESIRED_MAX_TX_PER_LEDGER = self.spec.max_tx_per_ledger
         if self.spec.ingest_rate_limit is not None:
             cfg.INGEST_RATE_LIMIT = self.spec.ingest_rate_limit
         if self.spec.ingest_surge_high_water is not None:

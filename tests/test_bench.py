@@ -189,6 +189,16 @@ def test_bench_close_subprocess_success_path():
     assert out["apply_workers"] >= 0
     assert 0.0 <= out["apply_parallel_pct"] <= 100.0
     assert out["apply_conflict_fallbacks"] >= 0
+    # state-plane hash pipeline (ISSUE r22): paired host/device legs,
+    # a merge wall, and the resolved backend ride every close line.
+    # The host leg must always measure (native or hashlib); the device
+    # leg may be 0.0 only if no device kernel loads in the child
+    assert out["bucket_hash_mb_per_sec"]["host"] > 0
+    assert out["bucket_hash_mb_per_sec"]["device"] >= 0
+    assert out["bucket_merge_ms"] >= 0
+    assert out["bucket_hash_backend"] in (
+        "native", "hashlib", "device-xla", "device-pallas"
+    )
 
 
 def test_probe_tpu_alive_success_path(monkeypatch):

@@ -2,9 +2,10 @@
 
 The reference runs SOCI over sqlite/postgres; this framework's Database is
 stdlib sqlite3 with the same shape (connection-string parse, nested
-transactions, per-query timers, schema versioning — README "Scope: database
-backends" records the deliberate postgres scope-out, so the postgres
-smoketest/performance cases (DatabaseTests.cpp:190-328) have no port).
+transactions, per-query timers, schema versioning).  The postgres backend
+is wired through database/dialect.py and covered in test_dialect.py — the
+live half (the DatabaseTests.cpp:190-328 smoketest shapes) runs only when
+STELLAR_TPU_PG_DSN names a reachable server and a driver is importable.
 """
 
 from __future__ import annotations
@@ -105,8 +106,11 @@ class TestSchema:
         assert db.get_schema_version() == SCHEMA_VERSION
 
     def test_connection_string_rejects_unknown_backend(self):
+        # postgresql:// is a KNOWN backend now (it attempts a live
+        # connect — the no-driver refusal is pinned in test_dialect.py);
+        # a backend nobody maps must still fail loudly at parse time.
         with pytest.raises(ValueError):
-            Database("postgresql://host/db")
+            Database("mysql://host/db")
 
 
 class TestLazyBufferedSavepoints:

@@ -18,6 +18,7 @@ from stellar_tpu.database.dialect import (
     PostgresDialect,
     SqliteDialect,
     dialect_for,
+    load_pg_driver,
 )
 
 
@@ -79,6 +80,79 @@ def test_postgres_dialect_mapping_decisions():
     )
 
 
+def test_postgres_rewrites_insert_or_replace_to_on_conflict():
+    """The store buffer's flush surface: sqlite's INSERT OR REPLACE keys
+    on the PK implicitly; postgres needs it named.  Every registered
+    table rewrites; an unregistered one refuses loudly."""
+    d = PostgresDialect()
+    assert d.rewrite(
+        "INSERT OR REPLACE INTO publishqueue (ledger, state) VALUES (?,?)"
+    ) == (
+        "INSERT INTO publishqueue (ledger, state) VALUES (?,?)"
+        " ON CONFLICT (ledger) DO UPDATE SET state=EXCLUDED.state"
+    )
+    from stellar_tpu.ledger.accountframe import AccountFrame
+
+    out = d.rewrite(AccountFrame._UPSERT_SQL)
+    assert out.startswith("INSERT INTO accounts (balance, seqnum,")
+    assert "ON CONFLICT (accountid) DO UPDATE SET" in out
+    assert "balance=EXCLUDED.balance" in out
+    assert "accountid=EXCLUDED.accountid" not in out  # PK not re-set
+    # composite PK: only the non-key columns land in the SET list
+    out = d.rewrite(
+        "INSERT OR REPLACE INTO trustlines (accountid, assettype, issuer,"
+        " assetcode, tlimit, balance, flags, lastmodified)"
+        " VALUES (?,?,?,?,?,?,?,?)"
+    )
+    assert "ON CONFLICT (accountid, issuer, assetcode) DO UPDATE SET" in out
+    assert "assettype=EXCLUDED.assettype" in out
+    assert "issuer=EXCLUDED.issuer" not in out
+    with pytest.raises(ValueError, match="no registered conflict target"):
+        d.rewrite("INSERT OR REPLACE INTO mystery (a, b) VALUES (?,?)")
+    # the full translate pipeline composes rewrite THEN placeholders
+    assert d.translate(
+        "INSERT OR REPLACE INTO publishqueue (ledger, state) VALUES (?,?)"
+    ).endswith("VALUES (%s,%s) ON CONFLICT (ledger) DO UPDATE SET"
+               " state=EXCLUDED.state")
+
+
+def test_postgres_rewrites_create_table_types():
+    d = PostgresDialect()
+    out = d.rewrite(
+        "CREATE TABLE t (a INT NOT NULL, b BIGINT, c BLOB,"
+        " d DOUBLE PRECISION, e INTEGER PRIMARY KEY)"
+    )
+    assert "a INTEGER NOT NULL" in out
+    assert "b BIGINT" in out          # BIGINT untouched (not \bINT\b)
+    assert "c BYTEA" in out
+    assert "d DOUBLE PRECISION" in out
+    assert "e INTEGER PRIMARY KEY" in out
+    # non-DDL, non-upsert statements pass through untouched
+    sel = "SELECT balance FROM accounts WHERE accountid=?"
+    assert d.rewrite(sel) == sel
+
+
+def test_postgres_connect_refuses_clearly_without_driver(monkeypatch):
+    """No driver in this container: the connect path must fail with the
+    gated message, not an ImportError — and nothing may be installed."""
+    from stellar_tpu.database import database as dbmod
+
+    monkeypatch.setattr(dbmod, "load_pg_driver", lambda: None)
+    with pytest.raises(RuntimeError, match="no driver is importable"):
+        Database("postgresql://localhost/stellar")
+
+
+def test_pg_dsn_sentinel_resolves_from_environment(monkeypatch):
+    monkeypatch.delenv("STELLAR_TPU_PG_DSN", raising=False)
+    with pytest.raises(ValueError, match="STELLAR_TPU_PG_DSN"):
+        Database._pg_dsn("postgresql://env")
+    monkeypatch.setenv("STELLAR_TPU_PG_DSN", "postgresql://h:5/d")
+    assert Database._pg_dsn("postgresql://env") == "postgresql://h:5/d"
+    assert Database._pg_dsn("postgresql://") == "postgresql://h:5/d"
+    # an explicit DSN wins over the sentinel
+    assert Database._pg_dsn("postgresql://x/y") == "postgresql://x/y"
+
+
 def test_translate_hook_routes_every_query_path():
     """The placeholder-rewrite hook (identity-skipped on sqlite) sits on
     all four statement paths — a non-qmark backend sees every SQL
@@ -130,24 +204,101 @@ def test_capability_gate_materializes_without_total_changes_credit():
 
 
 _PG_DSN = os.environ.get("STELLAR_TPU_PG_DSN")
-
-
-@pytest.mark.skipif(
-    not _PG_DSN,
-    reason="STELLAR_TPU_PG_DSN not set (no postgres server in this "
-    "environment — the dialect's live half is certified where one exists)",
+_PG_GATE = pytest.mark.skipif(
+    not (_PG_DSN and load_pg_driver() is not None),
+    reason="STELLAR_TPU_PG_DSN not set or no postgres driver importable "
+    "(no postgres in this environment — the dialect's live half is "
+    "certified where one exists; nothing is installed for it)",
 )
+
+
+@_PG_GATE
 def test_postgres_savepoint_syntax_live():  # pragma: no cover - server-gated
-    psycopg2 = pytest.importorskip("psycopg2")
+    from stellar_tpu.database.database import connect_postgres
+
     d = PostgresDialect()
-    conn = psycopg2.connect(_PG_DSN)
+    conn = connect_postgres(_PG_DSN)
     try:
-        with conn.cursor() as cur:
-            cur.execute("BEGIN")
-            cur.execute(d.savepoint_sql("sp_t"))
-            cur.execute("SELECT 1")
-            cur.execute(d.rollback_to_sql("sp_t"))
-            cur.execute(d.release_sql("sp_t"))
-            cur.execute("ROLLBACK")
+        conn.execute("BEGIN")
+        conn.execute(d.savepoint_sql("sp_t"))
+        conn.execute("SELECT 1")
+        conn.execute(d.rollback_to_sql("sp_t"))
+        conn.execute(d.release_sql("sp_t"))
+        conn.execute("ROLLBACK")
     finally:
         conn.close()
+
+
+@_PG_GATE
+def test_nested_transactions_live_pg():  # pragma: no cover - server-gated
+    """The full Database savepoint machinery against a live server: a
+    rolled-back inner scope unwinds, the outer commit survives, and the
+    rewritten upsert path round-trips."""
+    db = Database(_PG_DSN if _PG_DSN.startswith("postgresql://")
+                  else f"postgresql://{_PG_DSN}")
+    try:
+        db.execute("DROP TABLE IF EXISTS publishqueue")
+        db.execute("CREATE TABLE publishqueue (ledger INTEGER PRIMARY KEY,"
+                   " state TEXT)")
+        up = "INSERT OR REPLACE INTO publishqueue (ledger, state) VALUES (?,?)"
+        with db.transaction():
+            db.execute(up, (1, "a"))
+            db.execute(up, (1, "b"))  # upsert overwrite, not a dup error
+            try:
+                with db.transaction():
+                    db.execute(up, (2, "x"))
+                    raise RuntimeError("inner abort")
+            except RuntimeError:
+                pass
+        assert db.query_all(
+            "SELECT ledger, state FROM publishqueue ORDER BY ledger"
+        ) == [(1, "b")]
+        db.execute("DROP TABLE publishqueue")
+    finally:
+        db.close()
+
+
+@_PG_GATE
+def test_cache_consistent_with_database_live_pg(
+):  # pragma: no cover - server-gated
+    """The acceptance oracle for the postgres plane: a full Application
+    boots on the live server, closes a funded-accounts ledger plus a
+    payment ledger with CacheIsConsistentWithDatabase enabled under the
+    ``raise`` policy, and stays green — every frame store, store-buffer
+    flush, and re-read crossed the rewritten dialect surface."""
+    from stellar_tpu.main.application import Application
+    from stellar_tpu.tx import testutils as T
+    from stellar_tpu.util import VIRTUAL_TIME, VirtualClock
+
+    clock = VirtualClock(VIRTUAL_TIME)
+    cfg = T.get_test_config(181)
+    cfg.DATABASE = _PG_DSN
+    cfg.INVARIANT_CHECKS = ["CacheIsConsistentWithDatabase"]
+    cfg.INVARIANT_FAIL_POLICY = "raise"
+    app = Application(clock, cfg, new_db=True)
+    try:
+        from stellar_tpu.ledger.accountframe import AccountFrame
+
+        root = T.root_key_for(app)
+        lm = app.ledger_manager
+
+        def seq(sk):
+            return AccountFrame.load_account(
+                sk.get_public_key(), app.database
+            ).get_seq_num() + 1
+
+        a, b = T.get_account("pg-a"), T.get_account("pg-b")
+        T.close_ledger_on(
+            app, lm.last_closed.header.scpValue.closeTime + 5,
+            [T.tx_from_ops(app, root, seq(root),
+                           [T.create_account_op(k, 10**12) for k in (a, b)])],
+        )
+        T.close_ledger_on(
+            app, lm.last_closed.header.scpValue.closeTime + 5,
+            [T.tx_from_ops(app, a, seq(a), [T.payment_op(b, 10**6)])],
+        )
+        assert app.invariants.total_violations == 0
+        assert app.invariants.closes_checked == 2
+    finally:
+        app.database.close()
+        clock.shutdown()

@@ -156,14 +156,22 @@ def test_native_merge_dedups_adjacent_duplicates(app, tmp_path):
     )
 
     via_python = python_merge(app, old, new, [], True)
-    out_native = native.merge_files(
-        old.path, new.path, [], True,
-        str(tmp_path / "dup-out.bucket"),
-    )
+    out_path = str(tmp_path / "dup-out.bucket")
+    out_native = native.merge_files_v2(old.path, new.path, [], True, out_path)
     assert out_native is not None
     native_hash, native_count = out_native
     assert native_count == 3  # accounts 1 (deduped), 2, 3
     assert native_hash == via_python.get_hash()
+    # same record stream byte for byte, and the v1 engine emits it too
+    assert open(out_path, "rb").read() == open(via_python.path, "rb").read()
+    out_v1 = native.merge_files(
+        old.path, new.path, [], True, str(tmp_path / "dup-out-v1.bucket")
+    )
+    assert out_v1 is not None and out_v1[1] == 3
+    assert (
+        open(str(tmp_path / "dup-out-v1.bucket"), "rb").read()
+        == open(out_path, "rb").read()
+    )
     # the surviving duplicate is the LAST one (balance 777)
     kept = [
         e.value.data.value.balance
